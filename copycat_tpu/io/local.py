@@ -1,0 +1,144 @@
+"""In-memory transport (Catalyst ``LocalTransport``/``LocalServerRegistry``).
+
+Hosts N logical nodes in one process — the substrate for the entire test
+pyramid, exactly as in the reference where every multi-node test runs a real
+Raft cluster over ``LocalTransport`` (reference ``AbstractServerTest.java:53-57``,
+SURVEY.md §4).  Messages are round-tripped through the serializer on every hop
+so wire-format bugs surface in unit tests, not just over TCP.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable
+
+from .serializer import Serializer
+from .transport import (
+    Address,
+    Client,
+    Connection,
+    ConnectionClosedError,
+    Server,
+    Transport,
+    TransportError,
+)
+
+
+class LocalServerRegistry:
+    """Shared address -> listening-server map (one per simulated network)."""
+
+    def __init__(self) -> None:
+        self._servers: dict[Address, "LocalServer"] = {}
+
+    def register(self, address: Address, server: "LocalServer") -> None:
+        self._servers[address] = server
+
+    def unregister(self, address: Address) -> None:
+        self._servers.pop(address, None)
+
+    def lookup(self, address: Address) -> "LocalServer | None":
+        return self._servers.get(address)
+
+
+class LocalConnection(Connection):
+    """One endpoint of an in-memory duplex channel."""
+
+    def __init__(self, serializer: Serializer) -> None:
+        super().__init__()
+        self._serializer = serializer
+        self.peer: "LocalConnection | None" = None
+
+    async def send(self, message: Any) -> Any:
+        peer = self.peer
+        if self.closed or peer is None or peer.closed:
+            raise ConnectionClosedError("connection closed")
+        # Round-trip through the wire format for fidelity with real transports.
+        wire = self._serializer.write(message)
+        delivered = peer._serializer.read(wire)
+        try:
+            result = await peer._handle(delivered)
+        except TransportError:
+            raise
+        except Exception as exc:
+            # Same marshalling contract as TcpConnection: handler errors cross
+            # the transport as TransportError("Type: message").
+            raise TransportError(f"{type(exc).__name__}: {exc}") from exc
+        if result is None:
+            return None
+        return self._serializer.read(peer._serializer.write(result))
+
+    async def close(self) -> None:
+        peer = self.peer
+        self._fire_close()
+        if peer is not None and not peer.closed:
+            peer._fire_close()
+
+
+class LocalClient(Client):
+    def __init__(self, registry: LocalServerRegistry, serializer: Serializer) -> None:
+        self._registry = registry
+        self._serializer = serializer
+        self._connections: list[LocalConnection] = []
+
+    async def connect(self, address: Address) -> Connection:
+        server = self._registry.lookup(address)
+        if server is None or server.closed:
+            raise TransportError(f"no server listening at {address}")
+        local = LocalConnection(self._serializer)
+        remote = LocalConnection(server._serializer)
+        local.peer = remote
+        remote.peer = local
+        self._connections.append(local)
+        local.on_close(lambda c: self._connections.remove(c) if c in self._connections else None)
+        # Give the server a chance to register handlers before first send.
+        server._accept(remote)
+        await asyncio.sleep(0)
+        return local
+
+    async def close(self) -> None:
+        for conn in list(self._connections):
+            await conn.close()
+        self._connections.clear()
+
+
+class LocalServer(Server):
+    def __init__(self, registry: LocalServerRegistry, serializer: Serializer) -> None:
+        self._registry = registry
+        self._serializer = serializer
+        self._address: Address | None = None
+        self._on_connect: Callable[[Connection], None] | None = None
+        self._connections: list[LocalConnection] = []
+        self.closed = False
+
+    async def listen(self, address: Address, on_connect: Callable[[Connection], None]) -> None:
+        self._address = address
+        self._on_connect = on_connect
+        self._registry.register(address, self)
+
+    def _accept(self, connection: LocalConnection) -> None:
+        assert self._on_connect is not None
+        self._connections.append(connection)
+        connection.on_close(
+            lambda c: self._connections.remove(c) if c in self._connections else None
+        )
+        self._on_connect(connection)
+
+    async def close(self) -> None:
+        self.closed = True
+        if self._address is not None:
+            self._registry.unregister(self._address)
+        for conn in list(self._connections):
+            await conn.close()
+        self._connections.clear()
+
+
+class LocalTransport(Transport):
+    def __init__(self, registry: LocalServerRegistry, serializer: Serializer | None = None) -> None:
+        self._registry = registry
+        self._serializer = serializer or Serializer()
+
+    def client(self) -> Client:
+        return LocalClient(self._registry, Serializer())
+
+    def server(self) -> Server:
+        return LocalServer(self._registry, Serializer())

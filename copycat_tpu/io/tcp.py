@@ -1,0 +1,170 @@
+"""TCP transport over asyncio streams (the reference's NettyTransport role).
+
+Frames: ``[u32 length][u8 kind][u64 correlation id][payload]`` where kind is
+REQUEST / RESPONSE / ERROR.  Payloads are serialized with the shared type-id
+serializer, so anything that crosses LocalTransport crosses TCP identically.
+This is the DCN/gRPC-role host-side transport of the TPU design (SURVEY.md
+§5.8): client sessions and cross-slice traffic ride here, while intra-step
+quorum traffic rides ICI collectives inside the compiled engine.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any, Callable
+
+from .serializer import Serializer
+from .transport import (
+    Address,
+    Client,
+    Connection,
+    ConnectionClosedError,
+    Server,
+    Transport,
+    TransportError,
+)
+
+_HEADER = struct.Struct(">IBQ")
+_REQUEST, _RESPONSE, _ERROR = 0, 1, 2
+
+
+class TcpConnection(Connection):
+    def __init__(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter, serializer: Serializer
+    ) -> None:
+        super().__init__()
+        self._reader = reader
+        self._writer = writer
+        self._serializer = serializer
+        self._next_id = 0
+        self._pending: dict[int, asyncio.Future] = {}
+        self._reader_task = asyncio.get_running_loop().create_task(self._read_loop())
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                header = await self._reader.readexactly(_HEADER.size)
+                length, kind, corr = _HEADER.unpack(header)
+                payload = await self._reader.readexactly(length)
+                if kind == _REQUEST:
+                    asyncio.get_running_loop().create_task(self._serve(corr, payload))
+                else:
+                    future = self._pending.pop(corr, None)
+                    if future is not None and not future.done():
+                        if kind == _ERROR:
+                            future.set_exception(TransportError(self._serializer.read(payload)))
+                        else:
+                            future.set_result(self._serializer.read(payload))
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self._abort()
+
+    async def _serve(self, corr: int, payload: bytes) -> None:
+        try:
+            message = self._serializer.read(payload)
+            result = await self._handle(message)
+            self._write_frame(_RESPONSE, corr, self._serializer.write(result))
+        except Exception as exc:  # marshal handler errors back to the caller
+            try:
+                self._write_frame(_ERROR, corr, self._serializer.write(f"{type(exc).__name__}: {exc}"))
+            except Exception:
+                pass
+
+    def _write_frame(self, kind: int, corr: int, payload: bytes) -> None:
+        if self.closed:
+            raise ConnectionClosedError("connection closed")
+        self._writer.write(_HEADER.pack(len(payload), kind, corr) + payload)
+
+    async def send(self, message: Any) -> Any:
+        if self.closed:
+            raise ConnectionClosedError("connection closed")
+        self._next_id += 1
+        corr = self._next_id
+        future: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[corr] = future
+        self._write_frame(_REQUEST, corr, self._serializer.write(message))
+        await self._writer.drain()
+        return await future
+
+    def _abort(self) -> None:
+        for future in self._pending.values():
+            if not future.done():
+                future.set_exception(ConnectionClosedError("connection closed"))
+        self._pending.clear()
+        self._fire_close()
+
+    async def close(self) -> None:
+        if not self.closed:
+            self._fire_close()
+            self._reader_task.cancel()
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except (ConnectionResetError, OSError):
+                pass
+        self._abort()
+
+
+class TcpClient(Client):
+    def __init__(self, serializer_factory: Callable[[], Serializer]) -> None:
+        self._serializer_factory = serializer_factory
+        self._connections: list[TcpConnection] = []
+
+    async def connect(self, address: Address) -> Connection:
+        reader, writer = await asyncio.open_connection(address.host, address.port)
+        conn = TcpConnection(reader, writer, self._serializer_factory())
+        self._connections.append(conn)
+        conn.on_close(lambda c: self._connections.remove(c) if c in self._connections else None)
+        return conn
+
+    async def close(self) -> None:
+        for conn in list(self._connections):
+            await conn.close()
+        self._connections.clear()
+
+
+class TcpServer(Server):
+    def __init__(self, serializer_factory: Callable[[], Serializer]) -> None:
+        self._serializer_factory = serializer_factory
+        self._server: asyncio.AbstractServer | None = None
+        self._connections: list[TcpConnection] = []
+
+    async def listen(self, address: Address, on_connect: Callable[[Connection], None]) -> None:
+        def accept(reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+            conn = TcpConnection(reader, writer, self._serializer_factory())
+            self._connections.append(conn)
+            conn.on_close(
+                lambda c: self._connections.remove(c) if c in self._connections else None
+            )
+            on_connect(conn)
+
+        self._server = await asyncio.start_server(accept, address.host, address.port)
+
+    async def close(self) -> None:
+        for conn in list(self._connections):
+            await conn.close()
+        self._connections.clear()
+        if self._server is not None:
+            self._server.close()
+            # Python >=3.12 wait_closed() also waits for client handlers; all
+            # connections are already closed above, but guard with a timeout in
+            # case a transport lingers in the event loop.
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 2.0)
+            except (TimeoutError, asyncio.TimeoutError):
+                pass
+
+
+class TcpTransport(Transport):
+    """Real-network transport; drop-in for LocalTransport."""
+
+    def __init__(self) -> None:
+        self._factory = Serializer
+
+    def client(self) -> Client:
+        return TcpClient(self._factory)
+
+    def server(self) -> Server:
+        return TcpServer(self._factory)

@@ -1,0 +1,67 @@
+"""Closeable callback registrations (Catalyst ``Listener``/``Listeners`` equivalent).
+
+The reference registers event callbacks everywhere and relies on the returned
+registration being closeable (e.g. ``InstanceSession`` unregisters its parent
+listener when the last local listener closes)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Generic, Iterator, TypeVar
+
+T = TypeVar("T")
+
+
+class Listener(Generic[T]):
+    """A single closeable callback registration."""
+
+    def __init__(self, callback: Callable[[T], Any], parent: "Listeners[T] | None" = None):
+        self._callback = callback
+        self._parent = parent
+        self._open = True
+
+    def accept(self, event: T) -> Any:
+        if self._open:
+            return self._callback(event)
+        return None
+
+    def close(self) -> None:
+        if self._open:
+            self._open = False
+            if self._parent is not None:
+                self._parent._remove(self)
+
+    @property
+    def is_open(self) -> bool:
+        return self._open
+
+
+class Listeners(Generic[T]):
+    """An ordered collection of listeners; iteration-safe under close()."""
+
+    def __init__(self) -> None:
+        self._listeners: list[Listener[T]] = []
+
+    def add(self, callback: Callable[[T], Any]) -> Listener[T]:
+        listener = Listener(callback, self)
+        self._listeners.append(listener)
+        return listener
+
+    def _remove(self, listener: Listener[T]) -> None:
+        try:
+            self._listeners.remove(listener)
+        except ValueError:
+            pass
+
+    def accept(self, event: T) -> None:
+        for listener in list(self._listeners):
+            listener.accept(event)
+
+    def __len__(self) -> int:
+        return len(self._listeners)
+
+    def __iter__(self) -> Iterator[Listener[T]]:
+        return iter(list(self._listeners))
+
+    def close(self) -> None:
+        for listener in list(self._listeners):
+            listener.close()

@@ -1,0 +1,71 @@
+"""Cancellable timers (Catalyst ``Scheduled`` equivalent).
+
+The reference's ``ThreadContext.schedule(delay[, interval]) -> Scheduled`` backs
+every election timeout and heartbeat.  State-machine TTL timers do NOT use this:
+they are log-time driven (see server/state_machine.py), matching the reference's
+deterministic timer discipline (SURVEY.md §5.9)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable
+
+logger = logging.getLogger(__name__)
+
+
+class Scheduled:
+    """Handle for a scheduled (optionally repeating) callback on the event loop.
+
+    Must be constructed inside a running event loop.  A repeating callback that
+    raises is logged and the schedule continues — a heartbeat/election timer
+    must never die silently on a transient error.
+    """
+
+    def __init__(
+        self,
+        delay: float,
+        interval: float | None,
+        callback: Callable[[], Awaitable[None] | None],
+    ) -> None:
+        self._delay = delay
+        self._interval = interval
+        self._callback = callback
+        self._task: asyncio.Task | None = asyncio.get_running_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        try:
+            await asyncio.sleep(self._delay)
+            while True:
+                try:
+                    result = self._callback()
+                    if asyncio.iscoroutine(result):
+                        await result
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    logger.exception("scheduled callback failed")
+                if self._interval is None:
+                    return
+                await asyncio.sleep(self._interval)
+        except asyncio.CancelledError:
+            pass
+
+    def cancel(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    @property
+    def is_done(self) -> bool:
+        return self._task is None or self._task.done()
+
+
+def schedule(delay: float, callback: Callable[[], Awaitable[None] | None]) -> Scheduled:
+    return Scheduled(delay, None, callback)
+
+
+def schedule_repeating(
+    delay: float, interval: float, callback: Callable[[], Awaitable[None] | None]
+) -> Scheduled:
+    return Scheduled(delay, interval, callback)

@@ -1,0 +1,34 @@
+"""Async test harness.
+
+The reference's tests use ConcurrentUnit's ``resume()``/``await()`` pattern
+(SURVEY.md §4); with asyncio we simply run each test body as a coroutine with a
+hard timeout so a hung cluster fails rather than wedging the suite.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Awaitable, Callable
+
+
+def arun(coro: Awaitable[Any], timeout: float = 60.0) -> Any:
+    async def wrapped() -> Any:
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.run(wrapped())
+
+
+def async_test(fn: Callable[..., Awaitable[None]] | None = None, *, timeout: float = 60.0):
+    """Decorator turning ``async def test_*`` into a sync pytest test."""
+
+    def deco(f: Callable[..., Awaitable[None]]):
+        @functools.wraps(f)
+        def sync(*args: Any, **kwargs: Any) -> None:
+            arun(f(*args, **kwargs), timeout=timeout)
+
+        return sync
+
+    if fn is not None:
+        return deco(fn)
+    return deco

@@ -1,0 +1,170 @@
+"""Substrate tests: buffers, serializer registry, local + TCP transports."""
+
+import pytest
+
+from copycat_tpu.io.buffer import BufferInput, BufferOutput
+from copycat_tpu.io.serializer import SerializationError, Serializer, serialize_with
+from copycat_tpu.io.local import LocalServerRegistry, LocalTransport
+from copycat_tpu.io.tcp import TcpTransport
+from copycat_tpu.io.transport import Address, TransportError
+
+from helpers import async_test
+
+
+def test_buffer_primitives_roundtrip():
+    out = BufferOutput()
+    out.write_u8(200).write_bool(True).write_i16(-5).write_i32(1 << 20)
+    out.write_i64(-(1 << 40)).write_f64(3.5).write_varint(-123456789)
+    out.write_bytes(b"\x00\xff").write_utf8("héllo")
+    buf = BufferInput(out.to_bytes())
+    assert buf.read_u8() == 200
+    assert buf.read_bool() is True
+    assert buf.read_i16() == -5
+    assert buf.read_i32() == 1 << 20
+    assert buf.read_i64() == -(1 << 40)
+    assert buf.read_f64() == 3.5
+    assert buf.read_varint() == -123456789
+    assert buf.read_bytes() == b"\x00\xff"
+    assert buf.read_utf8() == "héllo"
+    assert buf.remaining == 0
+
+
+def test_varint_edge_cases():
+    for value in (0, 1, -1, 127, 128, -128, 2**31, -(2**31), 2**62):
+        out = BufferOutput()
+        out.write_varint(value)
+        assert BufferInput(out.to_bytes()).read_varint() == value
+
+
+@serialize_with(900)
+class _Point:
+    def __init__(self, x=0, y=0, tags=None):
+        self.x, self.y, self.tags = x, y, tags or []
+
+    def write_object(self, buf, serializer):
+        buf.write_i64(self.x)
+        buf.write_i64(self.y)
+        serializer.write_object(self.tags, buf)
+
+    def read_object(self, buf, serializer):
+        self.x = buf.read_i64()
+        self.y = buf.read_i64()
+        self.tags = serializer.read_object(buf)
+
+
+def test_serializer_graph_roundtrip():
+    s = Serializer()
+    graph = {
+        "a": [1, 2.5, None, True, False, "x", b"bytes"],
+        "nested": {"p": _Point(3, 4, ["t1"]), "tuple": (1, 2), "set": {1, 2}},
+        "addr": Address("localhost", 5000),
+    }
+    back = s.read(s.write(graph))
+    assert back["a"] == graph["a"]
+    assert back["nested"]["tuple"] == (1, 2)
+    assert back["nested"]["set"] == {1, 2}
+    p = back["nested"]["p"]
+    assert (p.x, p.y, p.tags) == (3, 4, ["t1"])
+    assert back["addr"] == Address("localhost", 5000)
+
+
+def test_serializer_class_reference():
+    s = Serializer()
+    assert s.read(s.write(_Point)) is _Point
+
+
+def test_serializer_rejects_unregistered():
+    class Unregistered:
+        pass
+
+    with pytest.raises(SerializationError):
+        Serializer().write(Unregistered())
+
+
+@async_test
+async def test_local_transport_request_response():
+    registry = LocalServerRegistry()
+    transport = LocalTransport(registry)
+    server = transport.server()
+    address = Address("local", 1)
+
+    def on_connect(conn):
+        async def echo(msg):
+            return {"echo": msg}
+
+        conn.handler(str, echo)
+
+    await server.listen(address, on_connect)
+    client = transport.client()
+    conn = await client.connect(address)
+    assert await conn.send("hi") == {"echo": "hi"}
+    await client.close()
+    await server.close()
+
+
+@async_test
+async def test_local_transport_connect_failure():
+    transport = LocalTransport(LocalServerRegistry())
+    with pytest.raises(TransportError):
+        await transport.client().connect(Address("local", 99))
+
+
+@async_test
+async def test_local_transport_handler_exception_propagates():
+    registry = LocalServerRegistry()
+    transport = LocalTransport(registry)
+    server = transport.server()
+    address = Address("local", 2)
+
+    def on_connect(conn):
+        async def boom(msg):
+            raise RuntimeError("kaboom")
+
+        conn.handler(str, boom)
+
+    await server.listen(address, on_connect)
+    conn = await transport.client().connect(address)
+    # Same marshalling contract as TCP: handler errors cross as TransportError.
+    with pytest.raises(TransportError, match="kaboom"):
+        await conn.send("hi")
+    await server.close()
+
+
+@async_test
+async def test_tcp_transport_roundtrip():
+    transport = TcpTransport()
+    server = transport.server()
+    address = Address("127.0.0.1", 18765)
+
+    def on_connect(conn):
+        async def double(msg):
+            return [msg, msg]
+
+        conn.handler(int, double)
+
+    await server.listen(address, on_connect)
+    client = transport.client()
+    conn = await client.connect(address)
+    assert await conn.send(21) == [21, 21]
+    await client.close()
+    await server.close()
+
+
+@async_test
+async def test_tcp_transport_error_marshalling():
+    transport = TcpTransport()
+    server = transport.server()
+    address = Address("127.0.0.1", 18766)
+
+    def on_connect(conn):
+        async def fail(msg):
+            raise ValueError("bad input")
+
+        conn.handler(int, fail)
+
+    await server.listen(address, on_connect)
+    conn = await transport.client().connect(address)
+    with pytest.raises(TransportError, match="bad input"):
+        await conn.send(1)
+    await conn.close()
+    await server.close()
