@@ -1,0 +1,321 @@
+"""The client runtime: sessions, exactly-once submission, consistency routing.
+
+Mirrors the consumed Copycat client surface (SURVEY.md §2.3 "Client runtime"):
+``submit(Command/Query)`` with consistency-dependent routing (commands and
+LINEARIZABLE/BOUNDED queries to the leader; SEQUENTIAL/CAUSAL queries to any
+server), ``ConnectionStrategy`` (the reference's AtomixReplica pins its client
+to the colocated server — ``CombinedConnectionStrategy``), client-assigned
+command sequence numbers for exactly-once application, keep-alives, and the
+session event channel (``Session.publish/onEvent`` by event name).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import random
+import time
+import uuid
+from typing import Any, Callable
+
+from ..io.transport import Address, Connection, Transport, TransportError
+from ..protocol import messages as msg
+from ..protocol.operations import Command, Operation, Query
+from ..utils.listeners import Listener, Listeners
+from ..utils.managed import Managed
+from ..utils.scheduled import Scheduled
+from ..utils.tasks import spawn
+
+_client_counter = itertools.count()
+
+
+class ApplicationError(Exception):
+    """A state machine raised while applying the operation."""
+
+
+class SessionExpiredError(Exception):
+    """The server expired this client's session (missed keep-alives)."""
+
+
+class ConnectionStrategy:
+    """Orders servers for connection attempts."""
+
+    def order(self, members: list[Address]) -> list[Address]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class AnyConnectionStrategy(ConnectionStrategy):
+    def order(self, members: list[Address]) -> list[Address]:
+        shuffled = list(members)
+        random.shuffle(shuffled)
+        return shuffled
+
+
+class PinnedConnectionStrategy(ConnectionStrategy):
+    """Always try a specific server first (the reference replica's
+    ``CombinedConnectionStrategy`` — client pinned to the in-process server)."""
+
+    def __init__(self, address: Address) -> None:
+        self.address = address
+
+    def order(self, members: list[Address]) -> list[Address]:
+        rest = [m for m in members if m != self.address]
+        random.shuffle(rest)
+        return [self.address] + rest
+
+
+class ClientSession:
+    """Client-side session state + event dispatch (Copycat ``Session``)."""
+
+    def __init__(self, client: "RaftClient") -> None:
+        self._client = client
+        self.id: int | None = None
+        self.timeout = 0.0
+        self.state = "closed"  # closed -> open -> expired/closed
+        self.event_index = 0
+        self._event_listeners: dict[str, Listeners] = {}
+        self._open_listeners = Listeners()
+        self._close_listeners = Listeners()
+
+    def on_event(self, event: str, callback: Callable[[Any], Any]) -> Listener:
+        return self._event_listeners.setdefault(event, Listeners()).add(callback)
+
+    def on_open(self, callback: Callable[[Any], Any]) -> Listener:
+        return self._open_listeners.add(callback)
+
+    def on_close(self, callback: Callable[[Any], Any]) -> Listener:
+        return self._close_listeners.add(callback)
+
+    @property
+    def is_open(self) -> bool:
+        return self.state == "open"
+
+    @property
+    def is_expired(self) -> bool:
+        return self.state == "expired"
+
+    def publish(self, event: str, message: Any = None) -> None:
+        """Local loopback publish (client-side listeners only)."""
+        self._dispatch(event, message)
+
+    def _dispatch(self, event: str, message: Any) -> None:
+        listeners = self._event_listeners.get(event)
+        if listeners is not None:
+            listeners.accept(message)
+
+    def _opened(self) -> None:
+        self.state = "open"
+        self._open_listeners.accept(self)
+
+    def _expired(self) -> None:
+        if self.state != "expired":
+            self.state = "expired"
+            self._close_listeners.accept(self)
+
+    def _closed(self) -> None:
+        if self.state == "open":
+            self.state = "closed"
+            self._close_listeners.accept(self)
+
+
+class RaftClient(Managed):
+    """Submits commands/queries to a Raft cluster over one live connection."""
+
+    def __init__(
+        self,
+        members: list[Address],
+        transport: Transport,
+        session_timeout: float = 5.0,
+        connection_strategy: ConnectionStrategy | None = None,
+    ) -> None:
+        super().__init__()
+        self.members = list(members)
+        self.transport = transport
+        self.session_timeout = session_timeout
+        self.strategy = connection_strategy or AnyConnectionStrategy()
+        self.client_id = f"client-{uuid.uuid4().hex[:8]}-{next(_client_counter)}"
+
+        self._client = transport.client()
+        self._connection: Connection | None = None
+        self._connected_to: Address | None = None
+        self._leader_hint: Address | None = None
+        self._session = ClientSession(self)
+        self._command_seq = 0
+        # Exactly-once bookkeeping: the server may prune its response cache
+        # only up to the CONTIGUOUS prefix of completed seqs — a higher seq
+        # completing first must not ack a lower seq still being retried.
+        self._completed_seqs: set[int] = set()
+        self._acked_command_seq = 0
+        self._index = 0  # high-water log index seen (sequential consistency)
+        self._keepalive: Scheduled | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def session(self) -> ClientSession:
+        return self._session
+
+    @property
+    def index(self) -> int:
+        return self._index
+
+    async def _do_open(self) -> None:
+        await self._register()
+        interval = max(self._session.timeout / 4.0, 0.05)
+        self._keepalive = Scheduled(interval, interval, self._send_keepalive)
+
+    async def _do_close(self) -> None:
+        if self._keepalive is not None:
+            self._keepalive.cancel()
+            self._keepalive = None
+        if self._session.is_open and self._session.id is not None:
+            try:
+                response = await self._request(
+                    msg.UnregisterRequest(session_id=self._session.id))
+            except (TransportError, OSError, msg.ProtocolError, asyncio.TimeoutError):
+                pass
+        self._session._closed()
+        await self._client.close()
+        self._connection = None
+
+    # -- connection management --------------------------------------------
+
+    async def _connect(self) -> Connection:
+        if self._connection is not None and not self._connection.closed:
+            return self._connection
+        candidates: list[Address] = []
+        if self._leader_hint is not None:
+            candidates.append(self._leader_hint)
+        candidates += [a for a in self.strategy.order(self.members) if a not in candidates]
+        last_error: Exception | None = None
+        for address in candidates:
+            try:
+                conn = await self._client.connect(address)
+            except (TransportError, OSError) as e:
+                last_error = e
+                continue
+            conn.handler(msg.PublishRequest, self._on_publish)
+            self._connection = conn
+            self._connected_to = address
+            return conn
+        raise TransportError(f"no reachable server in {self.members}") from last_error
+
+    def _drop_connection(self) -> None:
+        conn = self._connection
+        self._connection = None
+        self._connected_to = None
+        if conn is not None and not conn.closed:
+            spawn(conn.close(), name="drop-connection")
+
+    async def _request(self, request: Any, leader_required: bool = True,
+                       attempts: int = 30) -> Any:
+        """Send with retry/re-route until a non-routing error or success."""
+        backoff = 0.01
+        last: Exception | None = None
+        for _ in range(attempts):
+            try:
+                conn = await self._connect()
+                response = await asyncio.wait_for(conn.send(request), self.session_timeout)
+            except (TransportError, OSError, asyncio.TimeoutError) as e:
+                last = e
+                self._drop_connection()
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 0.25)
+                continue
+            error = getattr(response, "error", None)
+            if error in (msg.NOT_LEADER, msg.NO_LEADER):
+                self._leader_hint = getattr(response, "leader", None)
+                members = getattr(response, "members", None)
+                if members:
+                    self.members = list(members)
+                if leader_required or self._leader_hint is None:
+                    self._drop_connection()
+                    await asyncio.sleep(backoff)
+                    backoff = min(backoff * 2, 0.25)
+                    continue
+            return response
+        raise msg.ProtocolError(msg.NO_LEADER, f"no leader after retries: {last}")
+
+    # -- session protocol --------------------------------------------------
+
+    async def _register(self) -> None:
+        response = await self._request(msg.RegisterRequest(
+            client_id=self.client_id, timeout=self.session_timeout))
+        response.raise_if_error()
+        self._session.id = response.session_id
+        self._session.timeout = response.timeout or self.session_timeout
+        if response.members:
+            self.members = list(response.members)
+        self._session._opened()
+
+    async def _send_keepalive(self) -> None:
+        if not self._session.is_open:
+            return
+        try:
+            response = await self._request(msg.KeepAliveRequest(
+                session_id=self._session.id,
+                command_seq=self._acked_command_seq,
+                event_index=self._session.event_index))
+        except (msg.ProtocolError, TransportError, OSError, asyncio.TimeoutError):
+            return
+        if response.error == msg.UNKNOWN_SESSION:
+            self._session._expired()
+        elif response.ok and response.members:
+            self.members = list(response.members)
+
+    async def _on_publish(self, request: msg.PublishRequest) -> msg.PublishResponse:
+        session = self._session
+        if request.session_id != session.id:
+            return msg.PublishResponse(event_index=session.event_index)
+        if request.prev_event_index != session.event_index:
+            # Gap or replay: report our position; the server resends from there.
+            return msg.PublishResponse(event_index=session.event_index)
+        for event, message in request.events or []:
+            try:
+                session._dispatch(event, message)
+            except Exception:  # listener errors must not poison the channel
+                pass
+        session.event_index = request.event_index
+        return msg.PublishResponse(event_index=session.event_index)
+
+    # -- operation submission ---------------------------------------------
+
+    async def submit(self, operation: Operation) -> Any:
+        if isinstance(operation, Query):
+            return await self._submit_query(operation)
+        return await self._submit_command(operation)
+
+    async def _submit_command(self, operation: Command) -> Any:
+        if not self._session.is_open:
+            raise SessionExpiredError("session is not open")
+        self._command_seq += 1
+        seq = self._command_seq
+        response = await self._request(msg.CommandRequest(
+            session_id=self._session.id, seq=seq, operation=operation))
+        return self._finish(response, seq)
+
+    async def _submit_query(self, operation: Query) -> Any:
+        if not self._session.is_open:
+            raise SessionExpiredError("session is not open")
+        consistency = operation.consistency()
+        response = await self._request(
+            msg.QueryRequest(session_id=self._session.id, index=self._index,
+                             operation=operation, consistency=consistency.value),
+            leader_required=consistency.value in ("linearizable", "bounded_linearizable"))
+        return self._finish(response, None)
+
+    def _finish(self, response: Any, seq: int | None) -> Any:
+        error = getattr(response, "error", None)
+        if error == msg.UNKNOWN_SESSION:
+            self._session._expired()
+            raise SessionExpiredError("session expired")
+        if error == msg.APPLICATION:
+            raise ApplicationError(response.error_detail or "application error")
+        response.raise_if_error()
+        if response.index:
+            self._index = max(self._index, response.index)
+        if seq is not None:
+            self._completed_seqs.add(seq)
+            while self._acked_command_seq + 1 in self._completed_seqs:
+                self._acked_command_seq += 1
+                self._completed_seqs.discard(self._acked_command_seq)
+        return response.result

@@ -1,0 +1,203 @@
+"""Wire protocol: client<->server session messages + server<->server Raft RPCs.
+
+Reconstructed from the API the reference consumes from the external Copycat jar
+(SURVEY.md §2.3 "Client runtime" / "Session protocol" / "Raft server core").
+Serialization ids 200-229 (the reference's op catalogs use 28-127; protocol
+messages lived in the external jar, so this block is new).
+
+Every response carries ``error`` (string) — ``NOT_LEADER`` additionally carries
+a ``leader`` hint so clients re-route; this is the uniform alternative to
+exception marshalling across transports.
+"""
+
+from __future__ import annotations
+
+from typing import Any, ClassVar
+
+from ..io.buffer import BufferInput, BufferOutput
+from ..io.serializer import Serializer, serialize_with
+
+# Error codes carried in response.error
+NOT_LEADER = "NOT_LEADER"
+NO_LEADER = "NO_LEADER"
+UNKNOWN_SESSION = "UNKNOWN_SESSION"
+INTERNAL = "INTERNAL"
+APPLICATION = "APPLICATION"  # state-machine raised; message in error_detail
+
+
+class ProtocolError(Exception):
+    def __init__(self, code: str, detail: str = "", leader: Any = None):
+        super().__init__(f"{code}: {detail}" if detail else code)
+        self.code = code
+        self.detail = detail
+        self.leader = leader
+
+
+class Message:
+    """Field-list serialization base: subclasses declare ``_fields``."""
+
+    _fields: ClassVar[tuple[str, ...]] = ()
+
+    def __init__(self, **kwargs: Any) -> None:
+        for name in self._fields:
+            setattr(self, name, kwargs.get(name))
+
+    def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
+        for name in self._fields:
+            serializer.write_object(getattr(self, name), buf)
+
+    def read_object(self, buf: BufferInput, serializer: Serializer) -> None:
+        for name in self._fields:
+            setattr(self, name, serializer.read_object(buf))
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{n}={getattr(self, n)!r}" for n in self._fields)
+        return f"{type(self).__name__}({inner})"
+
+
+class Response(Message):
+    """Base response: ``error`` is an error code, ``leader`` a routing hint."""
+
+    @property
+    def ok(self) -> bool:
+        return not getattr(self, "error", None)
+
+    def raise_if_error(self) -> "Response":
+        error = getattr(self, "error", None)
+        if error:
+            raise ProtocolError(error, getattr(self, "error_detail", "") or "",
+                                getattr(self, "leader", None))
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Client <-> server session protocol
+# ---------------------------------------------------------------------------
+
+
+@serialize_with(200)
+class RegisterRequest(Message):
+    _fields = ("client_id", "timeout")
+
+
+@serialize_with(201)
+class RegisterResponse(Response):
+    # session_id doubles as the registering entry's log index.
+    _fields = ("error", "error_detail", "leader", "session_id", "timeout", "members")
+
+
+@serialize_with(202)
+class KeepAliveRequest(Message):
+    # command_seq: highest command sequence the client has a response for.
+    # event_index: highest event index the client has processed.
+    _fields = ("session_id", "command_seq", "event_index")
+
+
+@serialize_with(203)
+class KeepAliveResponse(Response):
+    _fields = ("error", "error_detail", "leader", "members")
+
+
+@serialize_with(204)
+class UnregisterRequest(Message):
+    _fields = ("session_id",)
+
+
+@serialize_with(205)
+class UnregisterResponse(Response):
+    _fields = ("error", "error_detail", "leader")
+
+
+@serialize_with(206)
+class CommandRequest(Message):
+    # seq: client-assigned sequence for exactly-once application.
+    _fields = ("session_id", "seq", "operation")
+
+
+@serialize_with(207)
+class CommandResponse(Response):
+    # index: log index at which the command applied (the linearization point).
+    # event_index: highest event index published to this session at the time.
+    _fields = ("error", "error_detail", "leader", "index", "event_index", "result")
+
+
+@serialize_with(208)
+class QueryRequest(Message):
+    # index: client's high-water commit index for SEQUENTIAL/CAUSAL reads.
+    _fields = ("session_id", "index", "operation", "consistency")
+
+
+@serialize_with(209)
+class QueryResponse(Response):
+    _fields = ("error", "error_detail", "leader", "index", "result")
+
+
+@serialize_with(210)
+class PublishRequest(Message):
+    """Server -> client event push (session event channel).
+
+    ``events`` is a list of (event_name, payload) applied at ``index``;
+    ``prev_event_index`` lets the client detect gaps and request a replay via
+    keep-alive acks.
+    """
+
+    _fields = ("session_id", "event_index", "prev_event_index", "events")
+
+
+@serialize_with(211)
+class PublishResponse(Response):
+    _fields = ("error", "error_detail", "event_index")
+
+
+# ---------------------------------------------------------------------------
+# Server <-> server Raft RPCs
+# ---------------------------------------------------------------------------
+
+
+@serialize_with(216)
+class VoteRequest(Message):
+    _fields = ("term", "candidate", "last_log_index", "last_log_term")
+
+
+@serialize_with(217)
+class VoteResponse(Response):
+    _fields = ("error", "error_detail", "term", "voted")
+
+
+@serialize_with(218)
+class AppendRequest(Message):
+    # global_index: minimum replicated index across all members — followers may
+    # compact cleaned entries up to it (SURVEY.md §5.4 compaction contract).
+    # fill_to: end of the index window this append covers; entries omitted from
+    # the window were cleaned+compacted (effects superseded) — the follower
+    # gap-fills those slots and never applies them, mirroring the reference's
+    # replay-after-compaction semantics.
+    _fields = ("term", "leader", "prev_index", "prev_term", "entries", "commit_index",
+               "global_index", "fill_to")
+
+
+@serialize_with(219)
+class AppendResponse(Response):
+    # last_index: follower's last log index after the append (for next_index
+    # fast rewind on failure).
+    _fields = ("error", "error_detail", "term", "success", "last_index")
+
+
+@serialize_with(220)
+class JoinRequest(Message):
+    _fields = ("member",)
+
+
+@serialize_with(221)
+class JoinResponse(Response):
+    _fields = ("error", "error_detail", "leader", "members")
+
+
+@serialize_with(222)
+class LeaveRequest(Message):
+    _fields = ("member",)
+
+
+@serialize_with(223)
+class LeaveResponse(Response):
+    _fields = ("error", "error_detail", "leader", "members")

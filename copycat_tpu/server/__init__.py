@@ -1,0 +1,45 @@
+"""The Raft consensus core (Copycat ``CopycatServer`` equivalent), CPU oracle.
+
+This is the always-correct reference implementation of the consensus layer the
+TPU engine (``copycat_tpu.models``) batches over groups.  Layout:
+
+- ``log``      — entry types, segmented log, Storage levels, clean()/compaction
+- ``state_machine`` — the StateMachine SPI: Commit, executor, log-time timers
+- ``session``  — server-side sessions: exactly-once, event push queues
+- ``raft``     — RaftServer: roles (follower/candidate/leader), RPCs, apply loop
+"""
+
+from .log import (
+    CommandEntry,
+    ConfigurationEntry,
+    Entry,
+    KeepAliveEntry,
+    Log,
+    NoOpEntry,
+    RegisterEntry,
+    Storage,
+    StorageLevel,
+    UnregisterEntry,
+)
+from .state_machine import Commit, StateMachine, StateMachineContext, StateMachineExecutor
+from .session import ServerSession
+from .raft import RaftServer
+
+__all__ = [
+    "Entry",
+    "RegisterEntry",
+    "KeepAliveEntry",
+    "UnregisterEntry",
+    "CommandEntry",
+    "NoOpEntry",
+    "ConfigurationEntry",
+    "Log",
+    "Storage",
+    "StorageLevel",
+    "Commit",
+    "StateMachine",
+    "StateMachineContext",
+    "StateMachineExecutor",
+    "ServerSession",
+    "RaftServer",
+]

@@ -1,0 +1,332 @@
+"""The replicated log: entries, segmented storage, incremental cleaning.
+
+The reference's storage contract (SURVEY.md §5.4): no snapshots — live state is
+*retained commits*; every applied commit must eventually be ``clean()``ed
+(effect superseded; entry reclaimable) and compaction drops cleaned entries.
+``Storage(StorageLevel.MEMORY|MAPPED|DISK, max_entries_per_segment, ...)``
+mirrors the reference builder surface (``withMaxEntriesPerSegment(16)`` in
+``StandaloneServerExample.java``).
+
+The TPU engine's equivalent of this file is a fixed-capacity ring + liveness
+bitmap per group (``copycat_tpu.ops.logring``); this CPU log is the oracle.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+from typing import Any, Iterator
+
+from ..io.buffer import BufferInput, BufferOutput
+from ..io.serializer import Serializer, serialize_with
+
+
+class StorageLevel(enum.Enum):
+    MEMORY = "memory"
+    MAPPED = "mapped"  # currently same path as DISK (buffered files)
+    DISK = "disk"
+
+
+class Storage:
+    """Log storage configuration (reference ``Storage`` builder equivalent)."""
+
+    def __init__(
+        self,
+        level: StorageLevel = StorageLevel.MEMORY,
+        directory: str | None = None,
+        max_entries_per_segment: int = 1024,
+        compaction_threshold: float = 0.5,
+    ) -> None:
+        self.level = level
+        self.directory = directory
+        self.max_entries_per_segment = max_entries_per_segment
+        self.compaction_threshold = compaction_threshold
+
+    def build_log(self, name: str = "log") -> "Log":
+        return Log(self, name)
+
+
+class Entry(object):
+    """Base log entry. ``index`` is assigned on append; ``timestamp`` is the
+    leader's clock at append time and drives all deterministic timers."""
+
+    _fields: tuple[str, ...] = ()
+
+    def __init__(self, term: int = 0, timestamp: float = 0.0, **kwargs: Any) -> None:
+        self.index = 0
+        self.term = term
+        self.timestamp = timestamp
+        for name in self._fields:
+            setattr(self, name, kwargs.get(name))
+
+    def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
+        buf.write_i64(self.index)
+        buf.write_i64(self.term)
+        buf.write_f64(self.timestamp)
+        for name in self._fields:
+            serializer.write_object(getattr(self, name), buf)
+
+    def read_object(self, buf: BufferInput, serializer: Serializer) -> None:
+        self.index = buf.read_i64()
+        self.term = buf.read_i64()
+        self.timestamp = buf.read_f64()
+        for name in self._fields:
+            setattr(self, name, serializer.read_object(buf))
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{n}={getattr(self, n)!r}" for n in self._fields)
+        return f"{type(self).__name__}(i={self.index}, t={self.term}{', ' if inner else ''}{inner})"
+
+
+@serialize_with(230)
+class NoOpEntry(Entry):
+    """Appended by a new leader to commit entries from prior terms and to
+    advance the deterministic state-machine clock (drives log-time timers)."""
+
+
+@serialize_with(231)
+class RegisterEntry(Entry):
+    _fields = ("client_id", "timeout")
+
+
+@serialize_with(232)
+class KeepAliveEntry(Entry):
+    _fields = ("session_id", "command_seq", "event_index")
+
+
+@serialize_with(233)
+class UnregisterEntry(Entry):
+    # expired=True when appended by the leader's session-timeout detector;
+    # False for a graceful client unregister.
+    _fields = ("session_id", "expired")
+
+
+@serialize_with(234)
+class CommandEntry(Entry):
+    _fields = ("session_id", "seq", "operation")
+
+
+@serialize_with(235)
+class ConfigurationEntry(Entry):
+    _fields = ("members",)
+
+
+class Log:
+    """Append-ordered entry store with incremental cleaning.
+
+    In-memory list with a base offset; DISK/MAPPED levels additionally append
+    serialized entries to segment files and recover by replay on open.
+    ``clean(index)`` marks an entry's effect superseded; ``compact()`` nulls
+    cleaned entries that every server has applied (they are never sent again),
+    freeing memory while preserving indices.
+    """
+
+    def __init__(self, storage: Storage, name: str = "log") -> None:
+        self._storage = storage
+        self._name = name
+        self._entries: list[Entry | None] = []
+        self._offset = 1  # index of _entries[0]
+        self._cleaned: set[int] = set()
+        # (start_index, term) for each term change — lets term_at() answer for
+        # compacted (None) slots, which matters for AppendEntries prev-term
+        # checks and vote up-to-date comparisons after compaction.
+        self._term_starts: list[tuple[int, int]] = []
+        self._serializer = Serializer()
+        self._segment_file = None
+        self._segment_count = 0
+        self._segment_index = 0
+        if storage.level in (StorageLevel.DISK, StorageLevel.MAPPED):
+            assert storage.directory, "DISK/MAPPED storage requires a directory"
+            os.makedirs(storage.directory, exist_ok=True)
+            self._recover()
+
+    # -- append/read -------------------------------------------------------
+
+    @property
+    def first_index(self) -> int:
+        return self._offset
+
+    @property
+    def last_index(self) -> int:
+        return self._offset + len(self._entries) - 1
+
+    @property
+    def empty(self) -> bool:
+        return not self._entries
+
+    def _note_term(self, index: int, term: int) -> None:
+        if not self._term_starts or self._term_starts[-1][1] != term:
+            if not self._term_starts or self._term_starts[-1][0] < index:
+                self._term_starts.append((index, term))
+
+    def append(self, entry: Entry) -> int:
+        entry.index = self.last_index + 1
+        self._entries.append(entry)
+        self._note_term(entry.index, entry.term)
+        if self._segment_dir is not None:
+            self._persist(entry)
+        return entry.index
+
+    def append_replicated(self, entry: Entry) -> None:
+        """Append an entry at its replicated index, gap-filling compacted
+        slots with None (a leader may legitimately skip cleaned+compacted
+        entries when replicating — their effects are superseded by design)."""
+        assert entry.index > self.last_index, f"{entry.index} <= {self.last_index}"
+        while self.last_index + 1 < entry.index:
+            self._entries.append(None)
+        self._entries.append(entry)
+        self._note_term(entry.index, entry.term)
+        if self._segment_dir is not None:
+            self._persist(entry)
+
+    def fill_gap(self, to_index: int) -> None:
+        """Extend the log with empty (compacted-elsewhere) slots up to to_index."""
+        while self.last_index < to_index:
+            self._entries.append(None)
+
+    def set_slot(self, entry: Entry) -> None:
+        """Place an entry into a previously gap-filled (None) slot."""
+        slot = entry.index - self._offset
+        if 0 <= slot < len(self._entries) and self._entries[slot] is None:
+            self._entries[slot] = entry
+            if self._segment_dir is not None:
+                self._persist(entry)
+
+    def get(self, index: int) -> Entry | None:
+        if index < self._offset or index > self.last_index:
+            return None
+        return self._entries[index - self._offset]
+
+    def entries_from(self, index: int, limit: int = 64) -> list[Entry]:
+        """Entries [index, index+limit) for replication. Compacted (None) slots
+        are skipped — they are only compacted once all members applied them."""
+        out = []
+        for i in range(max(index, self._offset), min(index + limit, self.last_index + 1)):
+            entry = self._entries[i - self._offset]
+            if entry is not None:
+                out.append(entry)
+        return out
+
+    def truncate(self, from_index: int) -> None:
+        """Remove entries >= from_index (conflict resolution on followers)."""
+        if from_index <= self.last_index:
+            keep = max(0, from_index - self._offset)
+            self._entries = self._entries[:keep]
+            self._cleaned = {i for i in self._cleaned if i < from_index}
+            self._term_starts = [(i, t) for i, t in self._term_starts if i < from_index]
+            if self._segment_dir is not None:
+                self._persist_truncate(from_index)
+
+    def term_at(self, index: int) -> int:
+        """Term of the entry at index; falls back to term-boundary tracking for
+        compacted slots. 0 means unknown (empty log, out of range, or a
+        gap-filled slot whose term was never seen)."""
+        entry = self.get(index)
+        if entry is not None:
+            return entry.term
+        if index < self._offset or index > self.last_index:
+            return 0
+        term = 0
+        for start, t in self._term_starts:
+            if start <= index:
+                term = t
+            else:
+                break
+        return term
+
+    def __iter__(self) -> Iterator[Entry]:
+        return (e for e in self._entries if e is not None)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    # -- cleaning / compaction --------------------------------------------
+
+    def clean(self, index: int) -> None:
+        self._cleaned.add(index)
+
+    def is_cleaned(self, index: int) -> bool:
+        return index in self._cleaned
+
+    @property
+    def cleaned_count(self) -> int:
+        return len(self._cleaned)
+
+    def compact(self, global_index: int) -> int:
+        """Null out cleaned entries with index <= global_index (the minimum
+        index applied on ALL servers).  Returns the number reclaimed."""
+        reclaimed = 0
+        for index in [i for i in self._cleaned if i <= global_index]:
+            slot = index - self._offset
+            if 0 <= slot < len(self._entries) and self._entries[slot] is not None:
+                self._entries[slot] = None
+                reclaimed += 1
+            self._cleaned.discard(index)
+        return reclaimed
+
+    # -- disk persistence --------------------------------------------------
+
+    @property
+    def _segment_dir(self) -> str | None:
+        if self._storage.level in (StorageLevel.DISK, StorageLevel.MAPPED):
+            return self._storage.directory
+        return None
+
+    def _segment_path(self, index: int) -> str:
+        return os.path.join(self._segment_dir, f"{self._name}-{index}.seg")
+
+    def _persist(self, entry: Entry) -> None:
+        if self._segment_file is None or self._segment_count >= self._storage.max_entries_per_segment:
+            if self._segment_file is not None:
+                self._segment_file.close()
+            self._segment_index = entry.index
+            self._segment_file = open(self._segment_path(entry.index), "ab")
+            self._segment_count = 0
+        data = self._serializer.write(entry)
+        frame = BufferOutput().write_bytes(data).to_bytes()
+        self._segment_file.write(frame)
+        self._segment_file.flush()
+        self._segment_count += 1
+
+    def _persist_truncate(self, from_index: int) -> None:
+        # Truncation is rare (follower conflict resolution): rewrite all
+        # segments from the surviving in-memory entries.
+        if self._segment_file is not None:
+            self._segment_file.close()
+            self._segment_file = None
+        for fname in os.listdir(self._segment_dir):
+            if fname.startswith(f"{self._name}-") and fname.endswith(".seg"):
+                os.remove(os.path.join(self._segment_dir, fname))
+        self._segment_count = 0
+        for entry in self._entries:
+            if entry is not None:
+                self._persist(entry)
+
+    def _recover(self) -> None:
+        directory = self._storage.directory
+        segments = []
+        for fname in os.listdir(directory):
+            if fname.startswith(f"{self._name}-") and fname.endswith(".seg"):
+                segments.append((int(fname[len(self._name) + 1 : -4]), fname))
+        for _, fname in sorted(segments):
+            with open(os.path.join(directory, fname), "rb") as f:
+                data = f.read()
+            buf = BufferInput(data)
+            while buf.remaining > 0:
+                entry = self._serializer.read(buf.read_bytes())
+                # Replayed entries keep their persisted indices.  Gap-filled
+                # (compacted-elsewhere) slots were never persisted, so recovery
+                # re-creates the gaps as None slots.
+                if entry.index > self.last_index:
+                    while self.last_index + 1 < entry.index:
+                        self._entries.append(None)
+                    self._entries.append(entry)
+                else:
+                    # Overwrite (post-truncate rewrite)
+                    self._entries[entry.index - self._offset] = entry
+                self._note_term(entry.index, entry.term)
+
+    def close(self) -> None:
+        if self._segment_file is not None:
+            self._segment_file.close()
+            self._segment_file = None

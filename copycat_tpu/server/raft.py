@@ -1,0 +1,1003 @@
+"""RaftServer: the consensus core (Copycat ``CopycatServer`` equivalent).
+
+Implements Raft proper — leader election, AppendEntries with log-matching,
+quorum commit advance — plus the linearizable session protocol the reference
+consumes: session registration through the log, keep-alives, exactly-once
+command application with response caching, consistency-routed queries
+(CAUSAL/SEQUENTIAL/BOUNDED_LINEARIZABLE/LINEARIZABLE), server-push events with
+the events-before-response rule for LINEARIZABLE commands (reference
+``Consistency.java:157-176``), deterministic log-time timers, session expiry
+fan-out (``ResourceManager.java:238-266``), and cluster membership join/leave.
+
+This is the CPU oracle; ``copycat_tpu.models.raft_groups`` is the batched
+TPU-tensor equivalent of the inner loops (vote tally, commit advance, apply).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import random
+import time
+from typing import Any, Callable
+
+from ..io.transport import Address, Connection, Transport, TransportError
+from ..protocol import messages as msg
+from ..protocol.operations import Command, CommandConsistency, QueryConsistency
+from ..utils.managed import Managed
+from ..utils.scheduled import Scheduled
+from ..utils.tasks import spawn
+from .log import (
+    CommandEntry,
+    ConfigurationEntry,
+    Entry,
+    KeepAliveEntry,
+    NoOpEntry,
+    RegisterEntry,
+    Storage,
+    StorageLevel,
+    UnregisterEntry,
+)
+from .session import ServerSession, SessionState
+from .state_machine import Commit, StateMachine, StateMachineExecutor
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+logger = logging.getLogger(__name__)
+
+
+class RaftServer(Managed):
+    """A single Raft replica hosting one top-level state machine."""
+
+    def __init__(
+        self,
+        address: Address,
+        members: list[Address],
+        transport: Transport,
+        state_machine: StateMachine,
+        storage: Storage | None = None,
+        election_timeout: float = 0.5,
+        heartbeat_interval: float = 0.1,
+        session_timeout: float = 5.0,
+        name: str = "raft",
+    ) -> None:
+        super().__init__()
+        self.address = address
+        self.members: list[Address] = list(members)
+        if address not in self.members:
+            self._joining = True
+        else:
+            self._joining = False
+        self.transport = transport
+        self.storage = storage or Storage(StorageLevel.MEMORY)
+        self.election_timeout = election_timeout
+        self.heartbeat_interval = heartbeat_interval
+        self.session_timeout = session_timeout
+        self.name = name
+
+        self.log = self.storage.build_log(name=f"{name}-{address.port}")
+        self.term = 0
+        self.voted_for: Address | None = None
+        self.commit_index = 0
+        self.last_applied = 0
+        self.global_index = 0
+
+        self.role = FOLLOWER
+        self.leader_address: Address | None = None
+
+        self.state_machine = state_machine
+        self.executor = StateMachineExecutor(log=self.log)
+        self.context = self.executor.context
+        self.context.logger = logging.getLogger(f"{name}-{address.port}")
+        state_machine.init(self.executor)
+
+        self.sessions: dict[int, ServerSession] = {}
+        self.context.sessions = self.sessions
+
+        # leader volatile state
+        self.next_index: dict[Address, int] = {}
+        self.match_index: dict[Address, int] = {}
+        self._last_quorum_contact: dict[Address, float] = {}
+        self._replication_events: dict[Address, asyncio.Event] = {}
+        self._replication_tasks: dict[Address, asyncio.Task] = {}
+        self._expiring_sessions: set[int] = set()
+
+        # apply-side bookkeeping
+        self._commit_futures: dict[int, asyncio.Future] = {}  # index -> (result, error)
+        self._touched_sessions: set[ServerSession] = set()
+        self._applied_event = asyncio.Event()  # pulsed on every apply advance
+
+        self._server = transport.server()
+        self._client = transport.client()
+        self._peer_connections: dict[Address, Connection] = {}
+        self._election_timer: Scheduled | None = None
+        self._leader_timer: Scheduled | None = None
+        self._closing = False
+
+        self._load_meta()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def _do_open(self) -> None:
+        self._closing = False
+        await self._server.listen(self.address, self._accept)
+        if self._joining:
+            await self._join_cluster()
+        self._become_follower(self.term, None, reset_timer=True)
+        logger.info("%s listening at %s (members=%s)", self.name, self.address, self.members)
+
+    async def _do_close(self) -> None:
+        self._closing = True
+        if self.role == LEADER and len(self.members) > 1:
+            # Best-effort graceful leave is NOT automatic (mirrors reference:
+            # explicit leave() is a separate call); just stop.
+            pass
+        self._cancel_timers()
+        self._stop_replication()
+        for fut in self._commit_futures.values():
+            if not fut.done():
+                fut.set_exception(msg.ProtocolError(msg.NO_LEADER, "server closed"))
+        self._commit_futures.clear()
+        await self._server.close()
+        await self._client.close()
+        self._peer_connections.clear()
+        self.log.close()
+
+    def _cancel_timers(self) -> None:
+        if self._election_timer is not None:
+            self._election_timer.cancel()
+            self._election_timer = None
+        if self._leader_timer is not None:
+            self._leader_timer.cancel()
+            self._leader_timer = None
+
+    async def leave(self) -> None:
+        """Gracefully leave the cluster (reference server leave test path)."""
+        if self.role == LEADER:
+            await self._append_and_wait(ConfigurationEntry(
+                members=[m for m in self.members if m != self.address]))
+        else:
+            conn = await self._leader_connection()
+            if conn is not None:
+                response = await conn.send(msg.LeaveRequest(member=self.address))
+                response.raise_if_error()
+
+    # ------------------------------------------------------------------
+    # persistence of (term, voted_for)
+    # ------------------------------------------------------------------
+
+    @property
+    def _meta_path(self) -> str | None:
+        if self.storage.directory:
+            return os.path.join(self.storage.directory, f"{self.name}-{self.address.port}.meta")
+        return None
+
+    def _persist_meta(self) -> None:
+        path = self._meta_path
+        if path:
+            with open(path, "w") as f:
+                json.dump({"term": self.term,
+                           "voted_for": str(self.voted_for) if self.voted_for else None}, f)
+
+    def _load_meta(self) -> None:
+        path = self._meta_path
+        if path and os.path.exists(path):
+            with open(path) as f:
+                meta = json.load(f)
+            self.term = meta.get("term", 0)
+            voted = meta.get("voted_for")
+            self.voted_for = Address.parse(voted) if voted else None
+
+    # ------------------------------------------------------------------
+    # connections
+    # ------------------------------------------------------------------
+
+    def _accept(self, connection: Connection) -> None:
+        connection.handler(msg.VoteRequest, self._on_vote)
+        connection.handler(msg.AppendRequest, self._on_append)
+        connection.handler(msg.RegisterRequest, lambda m: self._on_register(connection, m))
+        connection.handler(msg.KeepAliveRequest, lambda m: self._on_keepalive(connection, m))
+        connection.handler(msg.UnregisterRequest, self._on_unregister)
+        connection.handler(msg.CommandRequest, lambda m: self._on_command(connection, m))
+        connection.handler(msg.QueryRequest, self._on_query)
+        connection.handler(msg.JoinRequest, self._on_join)
+        connection.handler(msg.LeaveRequest, self._on_leave)
+
+    async def _peer_connection(self, peer: Address) -> Connection | None:
+        conn = self._peer_connections.get(peer)
+        if conn is not None and not conn.closed:
+            return conn
+        try:
+            conn = await self._client.connect(peer)
+        except (TransportError, OSError):
+            return None
+        self._peer_connections[peer] = conn
+        return conn
+
+    async def _leader_connection(self) -> Connection | None:
+        if self.leader_address is None or self.leader_address == self.address:
+            return None
+        return await self._peer_connection(self.leader_address)
+
+    @property
+    def peers(self) -> list[Address]:
+        return [m for m in self.members if m != self.address]
+
+    @property
+    def quorum(self) -> int:
+        return len(self.members) // 2 + 1
+
+    # ------------------------------------------------------------------
+    # role transitions
+    # ------------------------------------------------------------------
+
+    def _become_follower(self, term: int, leader: Address | None, reset_timer: bool = True) -> None:
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+            self._persist_meta()
+        was_leader = self.role == LEADER
+        self.role = FOLLOWER
+        if leader is not None:
+            self.leader_address = leader
+        if was_leader:
+            self._stop_replication()
+            self._fail_pending(msg.NOT_LEADER)
+            self._expiring_sessions.clear()
+        if reset_timer:
+            self._reset_election_timer()
+
+    def _reset_election_timer(self) -> None:
+        if self._election_timer is not None:
+            self._election_timer.cancel()
+        timeout = random.uniform(self.election_timeout, self.election_timeout * 2)
+        self._election_timer = Scheduled(timeout, None, self._start_election)
+
+    async def _start_election(self) -> None:
+        if self._closing or self.role == LEADER:
+            return
+        self.role = CANDIDATE
+        self.term += 1
+        self.voted_for = self.address
+        self.leader_address = None
+        self._persist_meta()
+        term = self.term
+        logger.debug("%s starting election for term %d", self.address, term)
+        self._reset_election_timer()  # re-elect if this round stalls
+
+        votes = 1  # self
+        if votes >= self.quorum:
+            self._become_leader()
+            return
+
+        async def request_vote(peer: Address) -> bool:
+            conn = await self._peer_connection(peer)
+            if conn is None:
+                return False
+            try:
+                response = await asyncio.wait_for(
+                    conn.send(msg.VoteRequest(
+                        term=term, candidate=self.address,
+                        last_log_index=self.log.last_index,
+                        last_log_term=self.log.term_at(self.log.last_index))),
+                    self.election_timeout)
+            except (TransportError, OSError, asyncio.TimeoutError):
+                return False
+            if response.term is not None and response.term > self.term:
+                self._become_follower(response.term, None)
+                return False
+            return bool(response.voted) and response.term == term
+
+        tasks = [asyncio.ensure_future(request_vote(p)) for p in self.peers]
+        for fut in asyncio.as_completed(tasks):
+            granted = await fut
+            if self.role != CANDIDATE or self.term != term:
+                break
+            if granted:
+                votes += 1
+                if votes >= self.quorum:
+                    self._become_leader()
+                    break
+        for t in tasks:
+            if not t.done():
+                t.cancel()
+
+    def _become_leader(self) -> None:
+        if self.role == LEADER:
+            return
+        self.role = LEADER
+        self.leader_address = self.address
+        logger.info("%s elected leader for term %d", self.address, self.term)
+        if self._election_timer is not None:
+            self._election_timer.cancel()
+            self._election_timer = None
+        for peer in self.peers:
+            self.next_index[peer] = self.log.last_index + 1
+            self.match_index[peer] = 0
+            self._replication_events[peer] = asyncio.Event()
+            self._replication_tasks[peer] = asyncio.get_running_loop().create_task(
+                self._replicate_loop(peer))
+        self._last_quorum_contact = {self.address: time.monotonic()}
+        # Commit an entry from this term immediately (Raft §5.4.2) and advance
+        # the state machine clock.
+        self._append(NoOpEntry())
+        self._leader_timer = Scheduled(self.heartbeat_interval, self.heartbeat_interval,
+                                       self._leader_maintenance)
+
+    def _stop_replication(self) -> None:
+        for task in self._replication_tasks.values():
+            task.cancel()
+        self._replication_tasks.clear()
+        self._replication_events.clear()
+        if self._leader_timer is not None:
+            self._leader_timer.cancel()
+            self._leader_timer = None
+
+    def _fail_pending(self, code: str) -> None:
+        for fut in self._commit_futures.values():
+            if not fut.done():
+                fut.set_exception(msg.ProtocolError(code, leader=self.leader_address))
+        self._commit_futures.clear()
+        for session in self.sessions.values():
+            for fut in session.command_futures.values():
+                if not fut.done():
+                    fut.set_exception(msg.ProtocolError(code, leader=self.leader_address))
+            session.command_futures.clear()
+            session.pending_ops.clear()
+            session.next_append_seq = 0  # re-derive on next leadership
+
+    # ------------------------------------------------------------------
+    # leader: append + replication + commit advance
+    # ------------------------------------------------------------------
+
+    def _append(self, entry: Entry) -> int:
+        entry.term = self.term
+        entry.timestamp = time.time()
+        index = self.log.append(entry)
+        self._signal_replication()
+        if len(self.members) == 1:
+            self._advance_commit()
+        return index
+
+    def _signal_replication(self) -> None:
+        for event in self._replication_events.values():
+            event.set()
+
+    async def _append_and_wait(self, entry: Entry) -> Any:
+        """Append an entry and wait until it is committed and applied."""
+        # Register the future before appending: on a single-member cluster the
+        # append commits and applies synchronously.
+        index = self.log.last_index + 1
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._commit_futures[index] = fut
+        actual = self._append(entry)
+        assert actual == index
+        return await fut
+
+    async def _replicate_loop(self, peer: Address) -> None:
+        event = self._replication_events[peer]
+        try:
+            while self.role == LEADER and not self._closing:
+                event.clear()
+                await self._replicate_once(peer)
+                if self.role != LEADER:
+                    return
+                if self.next_index.get(peer, 1) > self.log.last_index:
+                    try:
+                        await asyncio.wait_for(event.wait(), self.heartbeat_interval)
+                    except asyncio.TimeoutError:
+                        pass
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            logger.exception("replication loop to %s failed", peer)
+
+    async def _replicate_once(self, peer: Address) -> None:
+        conn = await self._peer_connection(peer)
+        if conn is None:
+            await asyncio.sleep(self.heartbeat_interval)
+            return
+        next_index = self.next_index.get(peer, self.log.last_index + 1)
+        prev_index = next_index - 1
+        prev_term = self.log.term_at(prev_index)
+        entries = self.log.entries_from(next_index, limit=64)
+        # End of the index window this append covers. Compacted (cleaned)
+        # entries inside it are omitted — they are only ever compacted once
+        # replicated to ALL members, so the follower already has them.
+        covered_end = min(next_index + 63, self.log.last_index)
+        request = msg.AppendRequest(
+            term=self.term, leader=self.address,
+            prev_index=prev_index, prev_term=prev_term,
+            entries=entries, commit_index=self.commit_index,
+            global_index=self.global_index,
+            fill_to=covered_end if covered_end >= next_index else None)
+        try:
+            response = await asyncio.wait_for(conn.send(request), self.election_timeout)
+        except (TransportError, OSError, asyncio.TimeoutError):
+            await asyncio.sleep(self.heartbeat_interval)
+            return
+        if self.role != LEADER:
+            return
+        if response.term is not None and response.term > self.term:
+            self._become_follower(response.term, None)
+            return
+        self._last_quorum_contact[peer] = time.monotonic()
+        if response.success:
+            match = max(prev_index, covered_end)
+            if match > self.match_index.get(peer, 0):
+                self.match_index[peer] = match
+            self.next_index[peer] = max(self.next_index.get(peer, 1), match + 1)
+            self._advance_commit()
+            if self.next_index[peer] <= self.log.last_index:
+                self._replication_events[peer].set()  # keep streaming
+        else:
+            hint = response.last_index if response.last_index is not None else prev_index - 1
+            new_next = max(1, min(prev_index, hint + 1))
+            if new_next == next_index:
+                # No rewind progress (e.g. follower in a weird state): back off
+                # instead of hot-spinning the failure path.
+                await asyncio.sleep(self.heartbeat_interval)
+            self.next_index[peer] = new_next
+            self._replication_events[peer].set()
+
+    def _advance_commit(self) -> None:
+        if self.role != LEADER:
+            return
+        matches = sorted(
+            [self.log.last_index]
+            + [self.match_index.get(p, 0) for p in self.peers],
+            reverse=True)
+        candidate = matches[self.quorum - 1]
+        if candidate > self.commit_index and self.log.term_at(candidate) == self.term:
+            self.commit_index = candidate
+            self._apply_up_to(self.commit_index)
+        # global index: minimum replicated position across all members
+        if self.peers:
+            self.global_index = min([self.log.last_index]
+                                    + [self.match_index.get(p, 0) for p in self.peers])
+        else:
+            self.global_index = self.last_applied
+        if self.log.cleaned_count > 0:
+            self.log.compact(min(self.global_index, self.last_applied))
+
+    # -- leader maintenance: clocks, session expiry ------------------------
+
+    def _leader_maintenance(self) -> None:
+        if self.role != LEADER or self._closing:
+            return
+        now_wall = time.time()
+        # Advance the deterministic clock when state-machine timers are due.
+        deadline = self.executor.next_deadline()
+        if deadline is not None and deadline <= now_wall:
+            self._append(NoOpEntry())
+        # Expire sessions that missed keep-alives (leader wall-clock detector;
+        # expiry itself is replicated + deterministic via UnregisterEntry).
+        now = time.monotonic()
+        for session in list(self.sessions.values()):
+            if session.state is not SessionState.OPEN or session.id in self._expiring_sessions:
+                continue
+            last = session.last_contact
+            if last and now - last > session.timeout:
+                self._expiring_sessions.add(session.id)
+                self._append(UnregisterEntry(session_id=session.id, expired=True))
+
+    def _lease_valid(self) -> bool:
+        """True if a quorum acked within the last election timeout (read lease)."""
+        if len(self.members) == 1:
+            return True
+        now = time.monotonic()
+        fresh = 1 + sum(
+            1 for p in self.peers
+            if now - self._last_quorum_contact.get(p, 0.0) < self.election_timeout)
+        return fresh >= self.quorum
+
+    async def _confirm_leadership(self) -> bool:
+        """Full linearizability barrier: round-trip a heartbeat to a quorum."""
+        if len(self.members) == 1:
+            return True
+        term = self.term
+
+        async def ping(peer: Address) -> bool:
+            conn = await self._peer_connection(peer)
+            if conn is None:
+                return False
+            try:
+                response = await asyncio.wait_for(
+                    conn.send(msg.AppendRequest(
+                        term=term, leader=self.address,
+                        prev_index=self.log.last_index,
+                        prev_term=self.log.term_at(self.log.last_index),
+                        entries=[], commit_index=self.commit_index)),
+                    self.election_timeout)
+            except (TransportError, OSError, asyncio.TimeoutError):
+                return False
+            if response.term is not None and response.term > self.term:
+                self._become_follower(response.term, None)
+                return False
+            if response.success:
+                self._last_quorum_contact[peer] = time.monotonic()
+            return bool(response.success)
+
+        results = await asyncio.gather(*(ping(p) for p in self.peers))
+        return self.role == LEADER and self.term == term and 1 + sum(results) >= self.quorum
+
+    # ------------------------------------------------------------------
+    # RPC handlers: raft
+    # ------------------------------------------------------------------
+
+    async def _on_vote(self, request: msg.VoteRequest) -> msg.VoteResponse:
+        if request.term > self.term:
+            self._become_follower(request.term, None)
+        if request.term < self.term:
+            return msg.VoteResponse(term=self.term, voted=False)
+        up_to_date = (request.last_log_term, request.last_log_index) >= (
+            self.log.term_at(self.log.last_index), self.log.last_index)
+        if self.voted_for in (None, request.candidate) and up_to_date:
+            self.voted_for = request.candidate
+            self._persist_meta()
+            self._reset_election_timer()
+            return msg.VoteResponse(term=self.term, voted=True)
+        return msg.VoteResponse(term=self.term, voted=False)
+
+    async def _on_append(self, request: msg.AppendRequest) -> msg.AppendResponse:
+        if request.term < self.term:
+            return msg.AppendResponse(term=self.term, success=False,
+                                      last_index=self.log.last_index)
+        if request.term > self.term or self.role != FOLLOWER:
+            self._become_follower(request.term, request.leader)
+        else:
+            self.leader_address = request.leader
+            self._reset_election_timer()
+
+        prev_index = request.prev_index or 0
+        if prev_index > 0:
+            if prev_index > self.log.last_index:
+                return msg.AppendResponse(term=self.term, success=False,
+                                          last_index=self.log.last_index)
+            local_term = self.log.term_at(prev_index)
+            # A term of 0 on either side means "unknown" (slot compacted or
+            # gap-filled cluster-wide) — log matching cannot check it; accept.
+            if local_term != 0 and (request.prev_term or 0) != 0 \
+                    and local_term != request.prev_term \
+                    and prev_index > self.last_applied:
+                self.log.truncate(prev_index)
+                return msg.AppendResponse(term=self.term, success=False,
+                                          last_index=self.log.last_index)
+
+        for entry in request.entries or []:
+            existing = self.log.get(entry.index)
+            if existing is not None and existing.term != entry.term:
+                self.log.truncate(entry.index)
+            if entry.index > self.log.last_index:
+                self.log.append_replicated(entry)
+            elif self.log.get(entry.index) is None and entry.index > self.last_applied:
+                self.log.set_slot(entry)
+
+        fill_to = request.fill_to or 0
+        if fill_to > self.log.last_index:
+            self.log.fill_gap(fill_to)
+
+        commit = min(request.commit_index or 0, self.log.last_index)
+        if commit > self.commit_index:
+            self.commit_index = commit
+            self._apply_up_to(commit)
+        global_index = getattr(request, "global_index", None)
+        if global_index:
+            self.log.compact(min(global_index, self.last_applied))
+        return msg.AppendResponse(term=self.term, success=True,
+                                  last_index=self.log.last_index)
+
+    # ------------------------------------------------------------------
+    # RPC handlers: membership
+    # ------------------------------------------------------------------
+
+    async def _join_cluster(self) -> None:
+        for attempt in range(20):
+            for member in self.members:
+                if member == self.address:
+                    continue
+                conn = None
+                try:
+                    conn = await self._client.connect(member)
+                    response = await asyncio.wait_for(
+                        conn.send(msg.JoinRequest(member=self.address)), 2.0)
+                except (TransportError, OSError, asyncio.TimeoutError):
+                    continue
+                if response.ok:
+                    self.members = list(response.members)
+                    self._joining = False
+                    return
+                if response.error == msg.NOT_LEADER and response.leader:
+                    try:
+                        conn2 = await self._client.connect(response.leader)
+                        response = await asyncio.wait_for(
+                            conn2.send(msg.JoinRequest(member=self.address)), 2.0)
+                        if response.ok:
+                            self.members = list(response.members)
+                            self._joining = False
+                            return
+                    except (TransportError, OSError, asyncio.TimeoutError):
+                        continue
+            await asyncio.sleep(0.2)
+        raise msg.ProtocolError(msg.NO_LEADER, "unable to join cluster")
+
+    async def _on_join(self, request: msg.JoinRequest) -> msg.JoinResponse:
+        if self.role != LEADER:
+            return msg.JoinResponse(error=msg.NOT_LEADER, leader=self.leader_address)
+        member = request.member
+        if member not in self.members:
+            new_members = self.members + [member]
+            await self._append_and_wait(ConfigurationEntry(members=new_members))
+        return msg.JoinResponse(members=self.members)
+
+    async def _on_leave(self, request: msg.LeaveRequest) -> msg.LeaveResponse:
+        if self.role != LEADER:
+            return msg.LeaveResponse(error=msg.NOT_LEADER, leader=self.leader_address)
+        member = request.member
+        if member in self.members:
+            new_members = [m for m in self.members if m != member]
+            await self._append_and_wait(ConfigurationEntry(members=new_members))
+        return msg.LeaveResponse(members=self.members)
+
+    # ------------------------------------------------------------------
+    # RPC handlers: session protocol
+    # ------------------------------------------------------------------
+
+    def _not_leader(self, response_type: type) -> Any:
+        return response_type(error=msg.NOT_LEADER if self.leader_address else msg.NO_LEADER,
+                             leader=self.leader_address)
+
+    async def _on_register(self, connection: Connection,
+                           request: msg.RegisterRequest) -> msg.RegisterResponse:
+        if self.role != LEADER:
+            response = self._not_leader(msg.RegisterResponse)
+            response.members = self.members
+            return response
+        timeout = request.timeout or self.session_timeout
+        try:
+            index, _, _ = await self._append_and_wait(
+                RegisterEntry(client_id=request.client_id, timeout=timeout))
+        except msg.ProtocolError as e:
+            return msg.RegisterResponse(error=e.code, leader=e.leader, members=self.members)
+        session = self.sessions.get(index)
+        if session is not None:
+            session.connection = connection
+            session.last_contact = time.monotonic()
+        return msg.RegisterResponse(session_id=index, timeout=timeout, members=self.members)
+
+    async def _on_keepalive(self, connection: Connection,
+                            request: msg.KeepAliveRequest) -> msg.KeepAliveResponse:
+        if self.role != LEADER:
+            response = self._not_leader(msg.KeepAliveResponse)
+            response.members = self.members
+            return response
+        session = self.sessions.get(request.session_id)
+        if session is None or session.state is not SessionState.OPEN:
+            return msg.KeepAliveResponse(error=msg.UNKNOWN_SESSION, members=self.members)
+        session.connection = connection
+        session.last_contact = time.monotonic()
+        try:
+            await self._append_and_wait(KeepAliveEntry(
+                session_id=request.session_id,
+                command_seq=request.command_seq or 0,
+                event_index=request.event_index or 0))
+        except msg.ProtocolError as e:
+            return msg.KeepAliveResponse(error=e.code, leader=e.leader, members=self.members)
+        # Resend any event batches the client is missing.
+        self._flush_events(session)
+        return msg.KeepAliveResponse(members=self.members)
+
+    async def _on_unregister(self, request: msg.UnregisterRequest) -> msg.UnregisterResponse:
+        if self.role != LEADER:
+            return self._not_leader(msg.UnregisterResponse)
+        if request.session_id in self.sessions:
+            try:
+                await self._append_and_wait(
+                    UnregisterEntry(session_id=request.session_id, expired=False))
+            except msg.ProtocolError as e:
+                return msg.UnregisterResponse(error=e.code, leader=e.leader)
+        return msg.UnregisterResponse()
+
+    async def _on_command(self, connection: Connection,
+                          request: msg.CommandRequest) -> msg.CommandResponse:
+        if self.role != LEADER:
+            return self._not_leader(msg.CommandResponse)
+        session = self.sessions.get(request.session_id)
+        if session is None or session.state is not SessionState.OPEN:
+            return msg.CommandResponse(error=msg.UNKNOWN_SESSION)
+        session.connection = connection
+        session.last_contact = time.monotonic()
+        seq = request.seq
+
+        # Exactly-once: already applied -> cached response.
+        cached = session.cached_response(seq)
+        if cached is not None:
+            index, result, error = cached
+            return self._command_response(session, index, result, error)
+        if seq <= session.command_high:
+            return msg.CommandResponse(error=msg.INTERNAL,
+                                       error_detail=f"response for seq {seq} already pruned")
+
+        # Already in flight (resubmission) -> share the future.
+        fut = session.command_futures.get(seq)
+        if fut is None:
+            fut = asyncio.get_running_loop().create_future()
+            session.command_futures[seq] = fut
+            # Append in client seq order: concurrent submits can arrive
+            # reordered (independent RPCs over reconnects); applying seq N
+            # after N+1 would silently drop the write.
+            if session.next_append_seq == 0:
+                session.next_append_seq = session.command_high + 1
+            session.pending_ops[seq] = request.operation
+            while session.next_append_seq in session.pending_ops:
+                next_seq = session.next_append_seq
+                session.next_append_seq += 1
+                self._append(CommandEntry(session_id=session.id, seq=next_seq,
+                                          operation=session.pending_ops.pop(next_seq)))
+        try:
+            index, result, error = await fut
+        except msg.ProtocolError as e:
+            return msg.CommandResponse(error=e.code, leader=e.leader)
+        finally:
+            if session.command_futures.get(seq) is fut:
+                del session.command_futures[seq]
+        return self._command_response(session, index, result, error)
+
+    def _command_response(self, session: ServerSession, index: int,
+                          result: Any, error: str | None) -> msg.CommandResponse:
+        if error:
+            return msg.CommandResponse(error=msg.APPLICATION, error_detail=error,
+                                       index=index, event_index=session.event_index)
+        return msg.CommandResponse(index=index, result=result,
+                                   event_index=session.event_index)
+
+    async def _on_query(self, request: msg.QueryRequest) -> msg.QueryResponse:
+        consistency = QueryConsistency(request.consistency or "linearizable")
+        if consistency in (QueryConsistency.LINEARIZABLE, QueryConsistency.BOUNDED_LINEARIZABLE):
+            if self.role != LEADER:
+                return self._not_leader(msg.QueryResponse)
+            if consistency is QueryConsistency.LINEARIZABLE:
+                if not await self._confirm_leadership():
+                    return self._not_leader(msg.QueryResponse)
+            elif not self._lease_valid():
+                if not await self._confirm_leadership():
+                    return self._not_leader(msg.QueryResponse)
+            # Serve at the latest committed state.
+            await self._wait_applied(self.commit_index)
+        else:
+            # SEQUENTIAL / CAUSAL: any server, at or after the client's index.
+            want = request.index or 0
+            ok = await self._wait_applied(want, timeout=self.election_timeout * 4)
+            if not ok:
+                return msg.QueryResponse(error=msg.INTERNAL,
+                                         error_detail="state lagging behind client index")
+        session = self.sessions.get(request.session_id)
+        commit = Commit(self.last_applied, session, self.context.clock,
+                        request.operation, None)
+        try:
+            result = self.executor.execute(commit)
+        except Exception as e:  # noqa: BLE001 - application errors cross as results
+            return msg.QueryResponse(error=msg.APPLICATION, error_detail=str(e),
+                                     index=self.last_applied)
+        finally:
+            commit.close()
+        return msg.QueryResponse(index=self.last_applied, result=result)
+
+    async def _wait_applied(self, index: int, timeout: float | None = None) -> bool:
+        deadline = (time.monotonic() + timeout) if timeout else None
+        while self.last_applied < index:
+            self._applied_event.clear()
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                return False
+            try:
+                await asyncio.wait_for(self._applied_event.wait(), remaining)
+            except asyncio.TimeoutError:
+                return False
+        return True
+
+    # ------------------------------------------------------------------
+    # apply loop
+    # ------------------------------------------------------------------
+
+    def _apply_up_to(self, commit_index: int) -> None:
+        while self.last_applied < commit_index:
+            index = self.last_applied + 1
+            entry = self.log.get(index)
+            self.last_applied = index
+            if entry is not None:
+                try:
+                    self._apply_entry(entry)
+                except Exception:
+                    logger.exception("apply failed at index %d", index)
+        self._applied_event.set()
+
+    def _apply_entry(self, entry: Entry) -> None:
+        self.context.index = entry.index
+        self.context.clock = max(self.context.clock, entry.timestamp)
+        self.executor.tick(self.context.clock)
+        self._touched_sessions = set()
+
+        result: Any = None
+        error: str | None = None
+        if isinstance(entry, RegisterEntry):
+            result = self._apply_register(entry)
+        elif isinstance(entry, KeepAliveEntry):
+            self._apply_keepalive(entry)
+        elif isinstance(entry, UnregisterEntry):
+            self._apply_unregister(entry)
+        elif isinstance(entry, CommandEntry):
+            result, error = self._apply_command(entry)
+        elif isinstance(entry, ConfigurationEntry):
+            self._apply_configuration(entry)
+        elif isinstance(entry, NoOpEntry):
+            self.log.clean(entry.index)
+
+        # Seal + push session events produced by this entry.
+        pushes: list[asyncio.Task] = []
+        for session in self._touched_sessions:
+            batch = session.commit_events()
+            if batch is not None and self.role == LEADER:
+                task = self._push_events(session)
+                if task is not None:
+                    pushes.append(task)
+
+        fut = self._commit_futures.pop(entry.index, None)
+        if fut is not None and not fut.done():
+            fut.set_result((entry.index, result, error))
+        if isinstance(entry, CommandEntry):
+            self._complete_command(entry, result, error, pushes)
+
+    def _session_touched(self, session: ServerSession) -> None:
+        self._touched_sessions.add(session)
+
+    def _apply_register(self, entry: RegisterEntry) -> int:
+        session = ServerSession(entry.index, entry.client_id, entry.timeout)
+        session.last_keepalive_time = self.context.clock
+        # Wire publish -> touched-session tracking for this apply step.
+        original_publish = session.publish
+
+        def tracked_publish(event: str, message: Any = None,
+                            _orig=original_publish, _s=session) -> None:
+            _orig(event, message)
+            self._session_touched(_s)
+
+        session.publish = tracked_publish  # type: ignore[method-assign]
+        self.sessions[entry.index] = session
+        if self.role == LEADER:
+            session.last_contact = time.monotonic()
+        self.state_machine.register(session)
+        return entry.index
+
+    def _apply_keepalive(self, entry: KeepAliveEntry) -> None:
+        session = self.sessions.get(entry.session_id)
+        if session is None:
+            return
+        session.last_keepalive_time = self.context.clock
+        session.ack_commands(entry.command_seq or 0)
+        session.ack_events(entry.event_index or 0)
+        self.log.clean(entry.index)
+
+    def _apply_unregister(self, entry: UnregisterEntry) -> None:
+        session = self.sessions.pop(entry.session_id, None)
+        self._expiring_sessions.discard(entry.session_id)
+        if session is None:
+            self.log.clean(entry.index)
+            return
+        if entry.expired:
+            session.expire()
+            self.state_machine.expire(session)
+        else:
+            session.close()
+        self.state_machine.close(session)
+        session.state = SessionState.EXPIRED if entry.expired else SessionState.CLOSED
+        self.log.clean(entry.index)
+
+    def _apply_command(self, entry: CommandEntry) -> tuple[Any, str | None]:
+        session = self.sessions.get(entry.session_id)
+        if session is None or session.state is not SessionState.OPEN:
+            self.log.clean(entry.index)
+            return None, "session expired or unknown"
+        if entry.seq and entry.seq <= session.command_high:
+            cached = session.cached_response(entry.seq)
+            if cached is not None:
+                _, result, error = cached
+                return result, error
+            # Duplicate append whose cached response was already pruned; the
+            # original apply completed any pending future, so this error result
+            # is only ever seen if something is deeply wrong — never a silent
+            # success for a skipped write.
+            return None, f"duplicate command seq {entry.seq} (response pruned)"
+        session.last_keepalive_time = self.context.clock
+        commit = Commit(entry.index, session, self.context.clock, entry.operation, self.log)
+        try:
+            result, error = self.executor.execute(commit), None
+        except Exception as e:  # noqa: BLE001
+            result, error = None, str(e)
+            self.log.clean(entry.index)
+        if entry.seq:
+            session.cache_response(entry.seq, entry.index, result, error)
+        return result, error
+
+    def _apply_configuration(self, entry: ConfigurationEntry) -> None:
+        self.members = list(entry.members)
+        if self.role == LEADER:
+            for peer in self.peers:
+                if peer not in self._replication_tasks:
+                    self.next_index[peer] = self.log.last_index + 1
+                    self.match_index[peer] = 0
+                    self._replication_events[peer] = asyncio.Event()
+                    self._replication_tasks[peer] = asyncio.get_running_loop().create_task(
+                        self._replicate_loop(peer))
+            for peer in list(self._replication_tasks):
+                if peer not in self.members:
+                    self._replication_tasks.pop(peer).cancel()
+                    self._replication_events.pop(peer, None)
+        self.log.clean(entry.index)
+
+    def _complete_command(self, entry: CommandEntry, result: Any, error: str | None,
+                          pushes: list[asyncio.Task]) -> None:
+        session = self.sessions.get(entry.session_id)
+        if session is None:
+            return
+        fut = session.command_futures.get(entry.seq)
+        if fut is None or fut.done():
+            return
+        operation = entry.operation
+        consistency = (operation.consistency()
+                       if isinstance(operation, Command) else CommandConsistency.LINEARIZABLE)
+        payload = (entry.index, result, error)
+        if pushes and consistency is CommandConsistency.LINEARIZABLE:
+            # Events-before-response: the response releases only after event
+            # pushes are acknowledged (reference Consistency.java:157-176).
+            async def complete_after_events() -> None:
+                try:
+                    await asyncio.wait_for(
+                        asyncio.gather(*pushes, return_exceptions=True), 1.0)
+                except asyncio.TimeoutError:
+                    pass
+                if not fut.done():
+                    fut.set_result(payload)
+
+            spawn(complete_after_events(), name="events-before-response")
+        else:
+            fut.set_result(payload)
+
+    # ------------------------------------------------------------------
+    # event push (leader only)
+    # ------------------------------------------------------------------
+
+    def _push_events(self, session: ServerSession) -> asyncio.Task | None:
+        if session.connection is None or session.connection.closed:
+            return None
+        return spawn(self._flush_events_async(session), name="event-push")
+
+    def _flush_events(self, session: ServerSession) -> None:
+        self._push_events(session)
+
+    async def _flush_events_async(self, session: ServerSession) -> None:
+        conn = session.connection
+        if conn is None or conn.closed:
+            return
+        for batch in list(session.event_queue):
+            if batch.event_index <= session.event_ack_index:
+                continue
+            try:
+                response = await asyncio.wait_for(
+                    conn.send(msg.PublishRequest(
+                        session_id=session.id,
+                        event_index=batch.event_index,
+                        prev_event_index=batch.prev_event_index,
+                        events=batch.events)),
+                    1.0)
+            except (TransportError, OSError, asyncio.TimeoutError):
+                return
+            if response.event_index is not None:
+                session.ack_events(response.event_index)
+                if response.event_index < batch.event_index:
+                    # client is behind; it will be caught up on next pass
+                    return

@@ -11,15 +11,20 @@ import asyncio
 import logging
 from typing import Awaitable, Callable
 
+from .tasks import spawn
+
 logger = logging.getLogger(__name__)
 
 
 class Scheduled:
     """Handle for a scheduled (optionally repeating) callback on the event loop.
 
-    Must be constructed inside a running event loop.  A repeating callback that
-    raises is logged and the schedule continues — a heartbeat/election timer
-    must never die silently on a transient error.
+    Must be constructed inside a running event loop.  Async callbacks run in a
+    detached (but strongly-referenced) task so that ``cancel()`` only cancels
+    the pending timer, never an in-flight callback — an election-timer callback
+    that resets its own timer must not cancel itself.  For repeating timers a
+    new invocation is skipped while the previous one is still running, so slow
+    callbacks (e.g. keep-alives during leader loss) never pile up.
     """
 
     def __init__(
@@ -31,25 +36,39 @@ class Scheduled:
         self._delay = delay
         self._interval = interval
         self._callback = callback
+        self._inflight: asyncio.Task | None = None
         self._task: asyncio.Task | None = asyncio.get_running_loop().create_task(self._run())
 
     async def _run(self) -> None:
         try:
             await asyncio.sleep(self._delay)
             while True:
-                try:
-                    result = self._callback()
-                    if asyncio.iscoroutine(result):
-                        await result
-                except asyncio.CancelledError:
-                    raise
-                except Exception:
-                    logger.exception("scheduled callback failed")
+                self._invoke()
                 if self._interval is None:
                     return
                 await asyncio.sleep(self._interval)
         except asyncio.CancelledError:
             pass
+
+    def _invoke(self) -> None:
+        if self._inflight is not None and not self._inflight.done():
+            return  # previous invocation still running - don't overlap
+        try:
+            result = self._callback()
+        except Exception:
+            logger.exception("scheduled callback failed")
+            return
+        if asyncio.iscoroutine(result):
+            self._inflight = spawn(self._guard(result), name="scheduled-callback")
+
+    @staticmethod
+    async def _guard(coro) -> None:
+        try:
+            await coro
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            logger.exception("scheduled async callback failed")
 
     def cancel(self) -> None:
         if self._task is not None:

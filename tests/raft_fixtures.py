@@ -1,0 +1,209 @@
+"""Shared Raft test fixtures: a tiny KV state machine + cluster builders.
+
+Mirrors the reference's test strategy (SURVEY.md §4): real N-server consensus
+over the in-memory transport, tiny inline state machines, no mocks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from copycat_tpu.io.local import LocalServerRegistry, LocalTransport
+from copycat_tpu.io.transport import Address
+from copycat_tpu.io.serializer import serialize_with
+from copycat_tpu.protocol.messages import Message
+from copycat_tpu.protocol.operations import Command, Query
+from copycat_tpu.server.log import Storage, StorageLevel
+from copycat_tpu.server.raft import LEADER, RaftServer
+from copycat_tpu.server.state_machine import Commit, StateMachine
+from copycat_tpu.client.client import RaftClient
+
+
+@serialize_with(910)
+class Put(Message, Command):
+    _fields = ("key", "value")
+
+
+@serialize_with(911)
+class Get(Message, Query):
+    _fields = ("key",)
+
+
+@serialize_with(916)
+class SeqGet(Get):
+    def consistency(self):
+        from copycat_tpu.protocol.operations import QueryConsistency
+
+        return QueryConsistency.SEQUENTIAL
+
+
+@serialize_with(917)
+class BoundedGet(Get):
+    def consistency(self):
+        from copycat_tpu.protocol.operations import QueryConsistency
+
+        return QueryConsistency.BOUNDED_LINEARIZABLE
+
+
+@serialize_with(912)
+class Notify(Message, Command):
+    """Publishes an event back to the submitting session."""
+
+    _fields = ("payload",)
+
+
+@serialize_with(913)
+class Fail(Message, Command):
+    """Always raises inside the state machine."""
+
+    _fields = ()
+
+
+@serialize_with(914)
+class PutTtl(Message, Command):
+    _fields = ("key", "value", "ttl")
+
+
+@serialize_with(915)
+class Count(Message, Query):
+    _fields = ()
+
+
+class KVStateMachine(StateMachine):
+    """Inline test machine exercising auto-registration, events, timers."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.data: dict[Any, Any] = {}
+        self.applied_ops = 0
+        self.expired_sessions: list[int] = []
+        self.closed_sessions: list[int] = []
+
+    def put(self, commit: Commit[Put]) -> Any:
+        self.applied_ops += 1
+        old = self.data.get(commit.operation.key)
+        self.data[commit.operation.key] = commit.operation.value
+        return old
+
+    def put_ttl(self, commit: Commit[PutTtl]) -> Any:
+        self.applied_ops += 1
+        op = commit.operation
+        old = self.data.get(op.key)
+        self.data[op.key] = op.value
+        key = op.key
+
+        def expire() -> None:
+            self.data.pop(key, None)
+            commit.clean()
+
+        self.executor.schedule(op.ttl, expire)
+        return old
+
+    def get(self, commit: Commit[Get]) -> Any:
+        return self.data.get(commit.operation.key)
+
+    def count(self, commit: Commit[Count]) -> int:
+        return len(self.data)
+
+    def notify(self, commit: Commit[Notify]) -> str:
+        commit.session.publish("poked", commit.operation.payload)
+        commit.clean()
+        return "notified"
+
+    def fail(self, commit: Commit[Fail]) -> None:
+        commit.clean()
+        raise ValueError("deliberate failure")
+
+    def expire(self, session: Any) -> None:
+        self.expired_sessions.append(session.id)
+
+    def close(self, session: Any) -> None:
+        self.closed_sessions.append(session.id)
+
+
+_port_counter = [6000]
+
+
+def next_ports(n: int) -> list[Address]:
+    base = _port_counter[0]
+    _port_counter[0] += n
+    return [Address("local", base + i) for i in range(n)]
+
+
+class Cluster:
+    def __init__(self, servers: list[RaftServer], registry: LocalServerRegistry):
+        self.servers = servers
+        self.registry = registry
+        self.clients: list[RaftClient] = []
+
+    @property
+    def leader(self) -> RaftServer | None:
+        for server in self.servers:
+            if server.is_open and server.role == LEADER:
+                return server
+        return None
+
+    async def await_leader(self, timeout: float = 10.0) -> RaftServer:
+        deadline = asyncio.get_running_loop().time() + timeout
+        while asyncio.get_running_loop().time() < deadline:
+            leader = self.leader
+            # Require a stable leader whose term is seen by a quorum
+            if leader is not None:
+                return leader
+            await asyncio.sleep(0.02)
+        raise TimeoutError("no leader elected")
+
+    async def client(self, session_timeout: float = 2.0) -> RaftClient:
+        client = RaftClient(
+            [s.address for s in self.servers],
+            LocalTransport(self.registry),
+            session_timeout=session_timeout,
+        )
+        await client.open()
+        self.clients.append(client)
+        return client
+
+    async def close(self) -> None:
+        for client in self.clients:
+            try:
+                await asyncio.wait_for(client.close(), 5)
+            except (Exception, asyncio.TimeoutError):
+                pass
+        for server in self.servers:
+            try:
+                await asyncio.wait_for(server.close(), 5)
+            except (Exception, asyncio.TimeoutError):
+                pass
+
+
+async def create_cluster(
+    n: int = 3,
+    machine_factory=KVStateMachine,
+    election_timeout: float = 0.2,
+    heartbeat_interval: float = 0.04,
+    session_timeout: float = 2.0,
+    storage: Storage | None = None,
+    storage_factory=None,
+) -> Cluster:
+    registry = LocalServerRegistry()
+    addresses = next_ports(n)
+    servers = []
+    for i, addr in enumerate(addresses):
+        store = storage_factory(i) if storage_factory else (storage or Storage(StorageLevel.MEMORY))
+        servers.append(
+            RaftServer(
+                addr,
+                addresses,
+                LocalTransport(registry),
+                machine_factory(),
+                storage=store,
+                election_timeout=election_timeout,
+                heartbeat_interval=heartbeat_interval,
+                session_timeout=session_timeout,
+            )
+        )
+    await asyncio.gather(*(s.open() for s in servers))
+    cluster = Cluster(servers, registry)
+    await cluster.await_leader()
+    return cluster
