@@ -1,0 +1,45 @@
+"""Distributed leader election (reference ``DistributedLeaderElection.java:66``).
+
+``on_election(cb)`` — the first local listener submits Listen; the "elect"
+event carries the EPOCH (= the winning Listen's commit index), which doubles
+as a fencing token validated with ``is_leader(epoch)``."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..resource.resource import AbstractResource, resource_info
+from ..utils.listeners import Listener, Listeners
+from . import commands as c
+from .state import LeaderElectionState
+
+
+@resource_info(state_machine=LeaderElectionState)
+class DistributedLeaderElection(AbstractResource):
+    def __init__(self, client: Any) -> None:
+        super().__init__(client)
+        self._listeners = Listeners()
+        self._listening = False
+        self.session().on_event("elect", self._on_elect)
+
+    def _on_elect(self, epoch: int) -> None:
+        self._listeners.accept(epoch)
+
+    async def on_election(self, callback: Callable[[int], Any]) -> Listener:
+        """Register for leadership; ``callback(epoch)`` fires when this
+        instance becomes leader."""
+        listener = self._listeners.add(callback)
+        if not self._listening:
+            self._listening = True
+            await self.submit(c.ElectionListen())
+        return listener
+
+    async def resign(self) -> None:
+        """Give up leadership / candidacy (submits Unlisten)."""
+        if self._listening:
+            self._listening = False
+            await self.submit(c.ElectionUnlisten())
+
+    async def is_leader(self, epoch: int) -> bool:
+        """Validate a fencing token against current leadership."""
+        return bool(await self.submit(c.ElectionIsLeader(epoch=epoch)))

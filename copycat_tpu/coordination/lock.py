@@ -1,0 +1,59 @@
+"""Distributed mutex (reference ``DistributedLock.java:58``).
+
+The grant is delivered as a session EVENT, not the command response: the
+client queues a waiter future and completes it when the "lock" event arrives
+(in FIFO order matching the server queue)."""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+from typing import Any
+
+from ..resource.resource import AbstractResource, resource_info
+from . import commands as c
+from .state import LockState
+
+
+@resource_info(state_machine=LockState)
+class DistributedLock(AbstractResource):
+    def __init__(self, client: Any) -> None:
+        super().__init__(client)
+        self._waiters: deque[asyncio.Future] = deque()
+        self.session().on_event("lock", self._on_lock_event)
+
+    def _on_lock_event(self, acquired: bool) -> None:
+        while self._waiters:
+            fut = self._waiters.popleft()
+            if not fut.done():
+                fut.set_result(bool(acquired))
+                return
+
+    async def _submit_lock(self, timeout: float) -> asyncio.Future:
+        """Queue a waiter and submit; on submit failure the waiter is removed
+        so a later grant cannot resolve a stale future out of order."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._waiters.append(fut)
+        try:
+            await self.submit(c.Lock(timeout=timeout))
+        except BaseException:
+            if fut in self._waiters:
+                self._waiters.remove(fut)
+            raise
+        return fut
+
+    async def lock(self) -> None:
+        """Acquire, waiting as long as it takes."""
+        fut = await self._submit_lock(-1)
+        acquired = await fut
+        assert acquired, "unbounded lock() resolved False"
+
+    async def try_lock(self, timeout: float | None = None) -> bool:
+        """Immediate attempt (timeout=None/0) or bounded wait (timeout>0).
+        Timeouts are measured in replicated log time: they may fire later than
+        wall clock, never earlier (reference tryLock Javadoc)."""
+        fut = await self._submit_lock(timeout or 0)
+        return await fut
+
+    async def unlock(self) -> None:
+        await self.submit(c.Unlock())

@@ -1,0 +1,190 @@
+"""Cluster facade (reference ``Atomix.java:58``, ``AtomixClient.java:35``,
+``AtomixReplica.java:45``, ``AtomixServer.java:40``).
+
+- :class:`Atomix` — ``exists/get/create/close`` over a RaftClient
+- :class:`AtomixClient` — stateless node (client only)
+- :class:`AtomixReplica` — client + server in one process, client pinned to the
+  colocated server (the reference's CombinedTransport/ConnectionStrategy)
+- :class:`AtomixServer` — standalone server (no client facade)
+
+Configuration is via typed keyword arguments plus a chained ``Builder`` for
+API parity with the reference's ``builder()`` surface (SURVEY.md §5.6).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Type, TypeVar
+
+from ..client.client import PinnedConnectionStrategy, RaftClient
+from ..io.transport import Address, Transport
+from ..resource.resource import Resource, resource_state_machine_of
+from ..server.log import Storage
+from ..server.raft import RaftServer
+from ..utils.managed import Managed
+from .instance import InstanceClient
+from .operations import CreateResource, GetResource, ResourceExists
+from .state import ResourceManager
+
+R = TypeVar("R", bound=Resource)
+
+
+class Atomix(Managed):
+    """Async facade over the resource catalog."""
+
+    def __init__(self, client: RaftClient) -> None:
+        super().__init__()
+        self.client = client
+        self._resources: dict[str, Resource] = {}  # get() singleton cache per node
+
+    async def exists(self, key: str) -> bool:
+        return bool(await self.client.submit(ResourceExists(key)))
+
+    async def get(self, key: str, resource_type: Type[R]) -> R:
+        """Singleton-per-node resource handle (reference ``Atomix.get:205-208``)."""
+        cached = self._resources.get(key)
+        if cached is not None:
+            if not isinstance(cached, resource_type):
+                raise ValueError(
+                    f"resource '{key}' already open as {type(cached).__name__}")
+            return cached
+        machine = resource_state_machine_of(resource_type)
+        instance_id = await self.client.submit(GetResource(key, machine))
+        resource = resource_type(InstanceClient(instance_id, self.client))
+        self._resources[key] = resource
+        return resource
+
+    async def create(self, key: str, resource_type: Type[R]) -> R:
+        """Fresh instance with its own virtual session per call
+        (reference ``Atomix.create:303-306``)."""
+        machine = resource_state_machine_of(resource_type)
+        instance_id = await self.client.submit(CreateResource(key, machine))
+        return resource_type(InstanceClient(instance_id, self.client))
+
+    async def _do_open(self) -> None:
+        await self.client.open()
+
+    async def _do_close(self) -> None:
+        self._resources.clear()
+        await self.client.close()
+
+
+class _Builder:
+    """Chained builder for API parity with the reference."""
+
+    def __init__(self, cls: type, address: Address | None, members: list[Address]) -> None:
+        self._cls = cls
+        self._kwargs: dict[str, Any] = {"address": address, "members": members}
+
+    def with_transport(self, transport: Transport) -> "_Builder":
+        self._kwargs["transport"] = transport
+        return self
+
+    def with_storage(self, storage: Storage) -> "_Builder":
+        self._kwargs["storage"] = storage
+        return self
+
+    def with_election_timeout(self, timeout: float) -> "_Builder":
+        self._kwargs["election_timeout"] = timeout
+        return self
+
+    def with_heartbeat_interval(self, interval: float) -> "_Builder":
+        self._kwargs["heartbeat_interval"] = interval
+        return self
+
+    def with_session_timeout(self, timeout: float) -> "_Builder":
+        self._kwargs["session_timeout"] = timeout
+        return self
+
+    def build(self) -> Any:
+        kwargs = dict(self._kwargs)
+        if self._cls is AtomixClient:
+            kwargs.pop("address", None)
+            kwargs.pop("storage", None)
+            kwargs.pop("election_timeout", None)
+            kwargs.pop("heartbeat_interval", None)
+        return self._cls(**kwargs)
+
+
+class AtomixClient(Atomix):
+    """Stateless node: pure client (reference ``AtomixClient.java``)."""
+
+    def __init__(self, members: list[Address], transport: Transport,
+                 session_timeout: float = 5.0) -> None:
+        super().__init__(RaftClient(members, transport, session_timeout=session_timeout))
+
+    @staticmethod
+    def builder(members: list[Address]) -> _Builder:
+        return _Builder(AtomixClient, None, members)
+
+
+class AtomixReplica(Atomix):
+    """Stateful node: embedded server + client pinned to it
+    (reference ``AtomixReplica.java:45``, ``build():355-379``)."""
+
+    def __init__(
+        self,
+        address: Address,
+        members: list[Address],
+        transport: Transport,
+        storage: Storage | None = None,
+        election_timeout: float = 0.5,
+        heartbeat_interval: float = 0.1,
+        session_timeout: float = 5.0,
+    ) -> None:
+        self.server = RaftServer(
+            address, members, transport, ResourceManager(), storage=storage,
+            election_timeout=election_timeout, heartbeat_interval=heartbeat_interval,
+            session_timeout=session_timeout)
+        client = RaftClient(
+            list(members), transport, session_timeout=session_timeout,
+            connection_strategy=PinnedConnectionStrategy(address))
+        super().__init__(client)
+        self.address = address
+
+    @staticmethod
+    def builder(address: Address, members: list[Address]) -> _Builder:
+        return _Builder(AtomixReplica, address, members)
+
+    async def _do_open(self) -> None:
+        # Server first, then the client session (reference AtomixReplica.open).
+        await self.server.open()
+        await self.client.open()
+
+    async def _do_close(self) -> None:
+        self._resources.clear()
+        await self.client.close()
+        await self.server.close()
+
+
+class AtomixServer(Managed):
+    """Standalone server hosting the ResourceManager (no client facade)."""
+
+    def __init__(
+        self,
+        address: Address,
+        members: list[Address],
+        transport: Transport,
+        storage: Storage | None = None,
+        election_timeout: float = 0.5,
+        heartbeat_interval: float = 0.1,
+        session_timeout: float = 5.0,
+    ) -> None:
+        super().__init__()
+        self.server = RaftServer(
+            address, members, transport, ResourceManager(), storage=storage,
+            election_timeout=election_timeout, heartbeat_interval=heartbeat_interval,
+            session_timeout=session_timeout)
+        self.address = address
+
+    @staticmethod
+    def builder(address: Address, members: list[Address]) -> _Builder:
+        return _Builder(AtomixServer, address, members)
+
+    async def _do_open(self) -> None:
+        await self.server.open()
+
+    async def _do_close(self) -> None:
+        await self.server.close()
+
+    async def leave(self) -> None:
+        await self.server.leave()

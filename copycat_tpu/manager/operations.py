@@ -1,0 +1,134 @@
+"""Catalog + envelope operations (reference serializer ids 30-38).
+
+- Catalog ops manage the name->resource registry: ``GetResource`` (id 35,
+  LINEARIZABLE), ``CreateResource`` (36, carries the state-machine class as a
+  registered class reference, cf. ``CreateResource.java:55-66``),
+  ``DeleteResource`` (37), ``ResourceExists`` (38, LINEARIZABLE query).
+- Envelope ops route an operation to a resource instance: ``InstanceCommand``
+  (30) / ``InstanceQuery`` (31); ``InstanceEvent`` (32) routes session events
+  back, filtered client-side by instance id.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..io.buffer import BufferInput, BufferOutput
+from ..io.serializer import Serializer, serialize_with
+from ..protocol.operations import Command, CommandConsistency, Persistence, Query, QueryConsistency
+
+
+class KeyOperation:
+    """Base for catalog ops addressing a resource by name (``KeyOperation.java``)."""
+
+    def __init__(self, key: str = "") -> None:
+        self.key = key
+
+    def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
+        buf.write_utf8(self.key)
+
+    def read_object(self, buf: BufferInput, serializer: Serializer) -> None:
+        self.key = buf.read_utf8()
+
+
+@serialize_with(35)
+class GetResource(KeyOperation, Command):
+    """Get-or-create the resource and attach (at most) one instance per client
+    session; returns the instance id."""
+
+    def __init__(self, key: str = "", state_machine: type | None = None) -> None:
+        super().__init__(key)
+        self.state_machine = state_machine
+
+    def consistency(self) -> CommandConsistency:
+        return CommandConsistency.LINEARIZABLE
+
+    def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
+        super().write_object(buf, serializer)
+        serializer.write_class(self.state_machine, buf)
+
+    def read_object(self, buf: BufferInput, serializer: Serializer) -> None:
+        super().read_object(buf, serializer)
+        self.state_machine = serializer.read_object(buf)
+
+
+@serialize_with(36)
+class CreateResource(GetResource):
+    """Like GetResource but always creates a fresh instance (unique session)."""
+
+
+@serialize_with(37)
+class DeleteResource(Command):
+    """Deletes a resource's replicated state entirely (by instance id)."""
+
+    def __init__(self, instance_id: int = 0) -> None:
+        self.instance_id = instance_id
+
+    def persistence(self) -> Persistence:
+        return Persistence.PERSISTENT
+
+    def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
+        buf.write_i64(self.instance_id)
+
+    def read_object(self, buf: BufferInput, serializer: Serializer) -> None:
+        self.instance_id = buf.read_i64()
+
+
+@serialize_with(38)
+class ResourceExists(KeyOperation, Query):
+    def consistency(self) -> QueryConsistency:
+        return QueryConsistency.LINEARIZABLE
+
+
+class InstanceOperation:
+    """Envelope (instance id, inner operation)."""
+
+    def __init__(self, resource: int = 0, operation: Any = None) -> None:
+        self.resource = resource
+        self.operation = operation
+
+    def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
+        buf.write_i64(self.resource)
+        serializer.write_object(self.operation, buf)
+
+    def read_object(self, buf: BufferInput, serializer: Serializer) -> None:
+        self.resource = buf.read_i64()
+        self.operation = serializer.read_object(buf)
+
+
+@serialize_with(30)
+class InstanceCommand(InstanceOperation, Command):
+    def consistency(self) -> CommandConsistency | None:
+        if isinstance(self.operation, Command):
+            return self.operation.consistency()
+        return CommandConsistency.LINEARIZABLE
+
+    def persistence(self) -> Persistence:
+        if isinstance(self.operation, Command):
+            return self.operation.persistence()
+        return Persistence.PERSISTENT
+
+
+@serialize_with(31)
+class InstanceQuery(InstanceOperation, Query):
+    def consistency(self) -> QueryConsistency | None:
+        if isinstance(self.operation, Query):
+            return self.operation.consistency()
+        return QueryConsistency.LINEARIZABLE
+
+
+@serialize_with(32)
+class InstanceEvent:
+    """Event payload envelope: (instance id, message) (``InstanceEvent.java``)."""
+
+    def __init__(self, resource: int = 0, message: Any = None) -> None:
+        self.resource = resource
+        self.message = message
+
+    def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
+        buf.write_i64(self.resource)
+        serializer.write_object(self.message, buf)
+
+    def read_object(self, buf: BufferInput, serializer: Serializer) -> None:
+        self.resource = buf.read_i64()
+        self.message = serializer.read_object(buf)

@@ -1,0 +1,249 @@
+"""ResourceManager — THE multiplexer (reference ``ResourceManager.java:35``).
+
+One replicated state machine hosting every resource:
+
+- ``keys``: name -> resource id (= the creating commit's log index,
+  ``ResourceManager.java:160``)
+- ``resources``: resource id -> (state machine, per-resource executor)
+- ``instances``: instance id -> (resource, virtual session, owner session)
+
+Instance ops are routed to the owning resource's executor with the commit
+re-parented onto the resource's virtual session (``operateResource:56``).
+Session expiry/close fans out to every resource the session touched
+(``ResourceManager.java:238-266``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable
+
+from ..server.session import ServerSession, SessionState
+from ..server.state_machine import Commit, StateMachine, StateMachineExecutor
+from ..resource.state_machine import ResourceStateMachine, ResourceStateMachineExecutor
+from .operations import (
+    CreateResource,
+    DeleteResource,
+    GetResource,
+    InstanceCommand,
+    InstanceEvent,
+    InstanceOperation,
+    InstanceQuery,
+    ResourceExists,
+)
+
+
+class ManagedResourceSession:
+    """Per-(resource-instance) virtual session bound to a client session
+    (reference ``ManagedResourceSession.java:38``): same lifecycle as the
+    parent, but events are wrapped in InstanceEvent for client-side routing."""
+
+    def __init__(self, instance_id: int, parent: ServerSession) -> None:
+        self.id = instance_id
+        self.parent = parent
+
+    @property
+    def state(self) -> SessionState:
+        return self.parent.state
+
+    @property
+    def is_open(self) -> bool:
+        return self.parent.is_open
+
+    @property
+    def timeout(self) -> float:
+        return self.parent.timeout
+
+    def publish(self, event: str, message: Any = None) -> None:
+        self.parent.publish(event, InstanceEvent(self.id, message))
+
+    def __repr__(self) -> str:
+        return f"ManagedResourceSession(instance={self.id}, client={self.parent.id})"
+
+
+class ManagerResourceExecutor(ResourceStateMachineExecutor):
+    """Per-resource executor: own callback map and logger, timers tracked for
+    cancel-on-delete (reference ``ResourceManagerStateMachineExecutor.java:43``)."""
+
+    def __init__(self, parent: StateMachineExecutor, resource_id: int, name: str) -> None:
+        super().__init__(parent)
+        self._context_logger = logging.getLogger(f"{name}-{resource_id}")
+        self._tracked: set[Any] = set()
+
+    def logger(self) -> logging.Logger:
+        return self._context_logger
+
+    def schedule(self, delay: float, callback: Callable[[], None], interval=None):
+        # One-shot timers untrack themselves on fire so a steady TTL workload
+        # doesn't pin every fired timer (+ its commit closure) until delete.
+        holder: dict[str, Any] = {}
+
+        def wrapped() -> None:
+            try:
+                callback()
+            finally:
+                if interval is None and "timer" in holder:
+                    self._tracked.discard(holder["timer"])
+
+        timer = super().schedule(delay, wrapped, interval)
+        holder["timer"] = timer
+        self._tracked.add(timer)
+        return timer
+
+    def close(self) -> None:
+        for timer in self._tracked:
+            timer.cancel()
+        self._tracked.clear()
+
+
+class ResourceHolder:
+    __slots__ = ("resource_id", "key", "state_machine", "executor")
+
+    def __init__(self, resource_id: int, key: str,
+                 state_machine: ResourceStateMachine,
+                 executor: ManagerResourceExecutor) -> None:
+        self.resource_id = resource_id
+        self.key = key
+        self.state_machine = state_machine
+        self.executor = executor
+
+
+class InstanceHolder:
+    __slots__ = ("instance_id", "resource", "session", "owner")
+
+    def __init__(self, instance_id: int, resource: ResourceHolder,
+                 session: ManagedResourceSession, owner: ServerSession) -> None:
+        self.instance_id = instance_id
+        self.resource = resource
+        self.session = session
+        self.owner = owner
+
+
+class _ReparentedCommit(Commit):
+    """Commit view with the session swapped for the resource's virtual session
+    (reference ``ResourceManagerCommit.java:31``)."""
+
+    __slots__ = ("_parent",)
+
+    def __init__(self, parent: Commit, session: ManagedResourceSession, operation: Any):
+        super().__init__(parent.index, session, parent.time, operation, None)
+        self._parent = parent
+
+    def clean(self) -> None:
+        self._parent.clean()
+
+    def close(self) -> None:
+        self._parent.close()
+
+
+class ResourceManager(StateMachine):
+    """The single top-level state machine wired into every server."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.keys: dict[str, int] = {}
+        self.resources: dict[int, ResourceHolder] = {}
+        self.instances: dict[int, InstanceHolder] = {}
+
+    # -- catalog ops -------------------------------------------------------
+
+    def get_resource(self, commit: Commit[GetResource]) -> int:
+        op = commit.operation
+        holder = self._get_or_create_resource(commit, op.key, op.state_machine)
+        # At most one instance per (resource, client session) for get()
+        # (reference getResource:77-146).
+        for instance in self.instances.values():
+            if instance.resource is holder and instance.owner is commit.session:
+                commit.clean()
+                return instance.instance_id
+        return self._create_instance(commit, holder).instance_id
+
+    def create_resource(self, commit: Commit[CreateResource]) -> int:
+        op = commit.operation
+        holder = self._get_or_create_resource(commit, op.key, op.state_machine)
+        return self._create_instance(commit, holder).instance_id
+
+    def resource_exists(self, commit: Commit[ResourceExists]) -> bool:
+        try:
+            return commit.operation.key in self.keys
+        finally:
+            commit.close()
+
+    def delete_resource(self, commit: Commit[DeleteResource]) -> bool:
+        try:
+            instance = self.instances.get(commit.operation.instance_id)
+            if instance is None:
+                return False
+            holder = instance.resource
+            holder.executor.close()
+            try:
+                holder.state_machine.delete()
+            except Exception:
+                logging.getLogger(__name__).exception("resource delete failed")
+            self.keys.pop(holder.key, None)
+            self.resources.pop(holder.resource_id, None)
+            for iid in [i for i, h in self.instances.items() if h.resource is holder]:
+                del self.instances[iid]
+            return True
+        finally:
+            commit.clean()
+
+    # -- instance op routing ----------------------------------------------
+
+    def instance_command(self, commit: Commit[InstanceCommand]) -> Any:
+        return self._operate(commit)
+
+    def instance_query(self, commit: Commit[InstanceQuery]) -> Any:
+        return self._operate(commit)
+
+    def _operate(self, commit: Commit) -> Any:
+        op: InstanceOperation = commit.operation
+        instance = self.instances.get(op.resource)
+        if instance is None:
+            commit.clean()
+            raise ValueError(f"unknown resource instance {op.resource}")
+        reparented = _ReparentedCommit(commit, instance.session, op.operation)
+        return instance.resource.executor.execute(reparented)
+
+    # -- internals ---------------------------------------------------------
+
+    def _get_or_create_resource(self, commit: Commit, key: str,
+                                machine_cls: type) -> ResourceHolder:
+        resource_id = self.keys.get(key)
+        if resource_id is not None:
+            holder = self.resources[resource_id]
+            if type(holder.state_machine) is not machine_cls:
+                commit.clean()
+                raise ValueError(
+                    f"resource '{key}' exists with type "
+                    f"{type(holder.state_machine).__name__}, not {machine_cls.__name__}")
+            return holder
+        resource_id = commit.index
+        self.keys[key] = resource_id
+        machine: ResourceStateMachine = machine_cls()
+        executor = ManagerResourceExecutor(self.executor, resource_id, key)
+        machine.init(executor)
+        holder = ResourceHolder(resource_id, key, machine, executor)
+        self.resources[resource_id] = holder
+        return holder
+
+    def _create_instance(self, commit: Commit, holder: ResourceHolder) -> InstanceHolder:
+        instance_id = commit.index
+        session = ManagedResourceSession(instance_id, commit.session)
+        instance = InstanceHolder(instance_id, holder, session, commit.session)
+        self.instances[instance_id] = instance
+        holder.state_machine.register(session)
+        return instance
+
+    # -- session lifecycle fan-out (SURVEY.md §3.4) ------------------------
+
+    def expire(self, session: ServerSession) -> None:
+        for instance in list(self.instances.values()):
+            if instance.owner is session:
+                instance.resource.state_machine.expire(instance.session)
+
+    def close(self, session: ServerSession) -> None:
+        for iid, instance in list(self.instances.items()):
+            if instance.owner is session:
+                instance.resource.state_machine.close(instance.session)
+                del self.instances[iid]

@@ -1,0 +1,81 @@
+"""Operation envelopes (reference ``ResourceCommand``/``ResourceQuery``/
+``ResourceOperation``, serializer ids 28/29/33; ``DeleteCommand`` from
+``ResourceStateMachine.java:53``).
+
+The wrapper carries (inner operation, consistency).  The inner operation's own
+consistency — when it declares one by overriding ``consistency()`` to a
+non-None value — overrides the wrapper's (reference ``ResourceCommand.java:40``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..io.buffer import BufferInput, BufferOutput
+from ..io.serializer import Serializer, serialize_with
+from ..protocol.operations import Command, CommandConsistency, Persistence, Query, QueryConsistency
+
+
+class ResourceOperation:
+    """Mixin for envelope ops: (operation, consistency-value)."""
+
+    def __init__(self, operation: Any = None, consistency: str | None = None) -> None:
+        self.operation = operation
+        self._consistency = consistency
+
+    def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
+        serializer.write_object(self.operation, buf)
+        serializer.write_object(self._consistency, buf)
+
+    def read_object(self, buf: BufferInput, serializer: Serializer) -> None:
+        self.operation = serializer.read_object(buf)
+        self._consistency = serializer.read_object(buf)
+
+
+@serialize_with(28)
+class ResourceCommand(ResourceOperation, Command):
+    """Wraps a resource command with the resource's write consistency."""
+
+    def consistency(self) -> CommandConsistency:
+        # An inner op that OVERRIDES consistency() declares its own level
+        # (the reference's "non-null overrides the wrapper" rule).
+        if isinstance(self.operation, Command) \
+                and type(self.operation).consistency is not Command.consistency:
+            inner = self.operation.consistency()
+            if inner is not None:
+                return inner
+        if self._consistency is not None:
+            return CommandConsistency(self._consistency)
+        return CommandConsistency.LINEARIZABLE
+
+    def persistence(self) -> Persistence:
+        if isinstance(self.operation, Command):
+            return self.operation.persistence()
+        return Persistence.PERSISTENT
+
+
+@serialize_with(29)
+class ResourceQuery(ResourceOperation, Query):
+    """Wraps a resource query with the resource's read consistency."""
+
+    def consistency(self) -> QueryConsistency:
+        if isinstance(self.operation, Query) \
+                and type(self.operation).consistency is not Query.consistency:
+            inner = self.operation.consistency()
+            if inner is not None:
+                return inner
+        if self._consistency is not None:
+            return QueryConsistency(self._consistency)
+        return QueryConsistency.LINEARIZABLE
+
+
+@serialize_with(34)
+class DeleteCommand(Command):
+    """Deletes the resource's replicated state (reference
+    ``ResourceStateMachine.java:53``)."""
+
+    def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
+        pass
+
+    def read_object(self, buf: BufferInput, serializer: Serializer) -> None:
+        pass

@@ -1,0 +1,97 @@
+"""Client-side resource base classes (reference ``Resource.java:41``,
+``AbstractResource.java:42``, ``ResourceInfo.java:31``, ``Resources.java:27``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Type, TypeVar
+
+from ..protocol.operations import Command, Operation, Query
+from .consistency import Consistency
+from .operations import DeleteCommand, ResourceCommand, ResourceQuery
+
+R = TypeVar("R", bound="Resource")
+
+
+def resource_info(state_machine: type) -> Callable[[type], type]:
+    """Binds a resource class to its server state machine class (the
+    reference's ``@ResourceInfo(stateMachine=...)`` annotation)."""
+
+    def bind(cls: type) -> type:
+        cls.__resource_state_machine__ = state_machine
+        return cls
+
+    return bind
+
+
+def resource_state_machine_of(resource_type: type) -> type:
+    """Walks the MRO for the bound state machine (``Resources.getInfo``)."""
+    for cls in resource_type.__mro__:
+        machine = cls.__dict__.get("__resource_state_machine__")
+        if machine is not None:
+            return machine
+    raise ValueError(f"{resource_type.__qualname__} has no @resource_info binding")
+
+
+class Resource:
+    """A distributed object replicated via the cluster (reference
+    ``Resource.java:41-78``): consistency config, session identity, delete."""
+
+    def __init__(self, client: Any) -> None:
+        # ``client`` is a RaftClient-shaped object - normally an InstanceClient
+        # (manager layer) so every op is routed to this resource's instance.
+        self.client = client
+        self._consistency = Consistency.ATOMIC
+
+    def with_consistency(self, consistency: Consistency) -> "Resource":
+        self._consistency = consistency
+        return self
+
+    @property
+    def consistency(self) -> Consistency:
+        return self._consistency
+
+    def session(self) -> Any:
+        return self.client.session()
+
+    async def delete(self) -> None:
+        """Delete the resource's replicated state."""
+        await self.client.submit(DeleteCommand())
+
+
+class AbstractResource(Resource):
+    """Wraps every submitted op in Resource{Command,Query} with the configured
+    consistency (reference ``AbstractResource.submit:73,88``)."""
+
+    async def submit(self, operation: Operation) -> Any:
+        if isinstance(operation, Query):
+            return await self.client.submit(
+                ResourceQuery(operation, self._consistency.read_consistency().value))
+        if isinstance(operation, Command):
+            return await self.client.submit(
+                ResourceCommand(operation, self._consistency.write_consistency().value))
+        raise TypeError(f"not an operation: {operation!r}")
+
+    async def _tracked_listener(self, listeners: Any, callback: Callable,
+                                state: dict, listen_op: Operation,
+                                unlisten_op_factory: Callable[[], Operation]):
+        """First-listener-registers / last-close-unregisters pattern
+        (reference ``DistributedAtomicValue.onChange`` et al.): the first local
+        listener submits ``listen_op`` server-side; closing the last one
+        submits the unlisten op in the background."""
+        from ..utils.tasks import spawn
+
+        if not state.get("listening"):
+            state["listening"] = True
+            await self.submit(listen_op)
+        listener = listeners.add(callback)
+        original_close = listener.close
+
+        def close_and_maybe_unlisten() -> None:
+            original_close()
+            if len(listeners) == 0 and state.get("listening"):
+                state["listening"] = False
+                spawn(self.submit(unlisten_op_factory()), name="resource-unlisten")
+
+        listener.close = close_and_maybe_unlisten  # type: ignore[method-assign]
+        return listener

@@ -819,8 +819,10 @@ class RaftServer(Managed):
     def _apply_entry(self, entry: Entry) -> None:
         self.context.index = entry.index
         self.context.clock = max(self.context.clock, entry.timestamp)
-        self.executor.tick(self.context.clock)
+        # Reset BEFORE ticking: timer callbacks publish session events too, and
+        # those must be sealed/pushed with this entry.
         self._touched_sessions = set()
+        self.executor.tick(self.context.clock)
 
         result: Any = None
         error: str | None = None

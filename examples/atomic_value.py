@@ -1,0 +1,34 @@
+"""Atomic value example (reference ``AtomicValueExample.java:29``): a client
+that repeatedly sets and reads a distributed value.
+
+    python examples/atomic_value.py 127.0.0.1:5001 [127.0.0.1:5002 ...]
+"""
+
+import asyncio
+import sys
+
+sys.path.insert(0, ".")
+
+from copycat_tpu.atomic import DistributedAtomicValue
+from copycat_tpu.io.tcp import TcpTransport
+from copycat_tpu.io.transport import Address
+from copycat_tpu.manager.atomix import AtomixClient
+
+
+async def main() -> None:
+    members = [Address.parse(a) for a in (sys.argv[1:] or ["127.0.0.1:5001"])]
+    client = AtomixClient.builder(members).with_transport(TcpTransport()).build()
+    await client.open()
+    print("client connected")
+
+    value = await client.get("value", DistributedAtomicValue)
+    counter = 0
+    while True:
+        await value.set(f"hello-{counter}")
+        print("set ->", await value.get())
+        counter += 1
+        await asyncio.sleep(1)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

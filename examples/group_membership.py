@@ -1,0 +1,40 @@
+"""Group membership example (reference ``GroupMembershipExample.java``): a
+replica that joins a membership group and prints join/leave events.
+
+    python examples/group_membership.py 127.0.0.1:5001 [peers...]
+"""
+
+import asyncio
+import sys
+
+sys.path.insert(0, ".")
+
+from copycat_tpu.coordination import DistributedMembershipGroup
+from copycat_tpu.io.tcp import TcpTransport
+from copycat_tpu.io.transport import Address
+from copycat_tpu.manager.atomix import AtomixReplica
+
+
+async def main() -> None:
+    args = sys.argv[1:] or ["127.0.0.1:5001"]
+    address = Address.parse(args[0])
+    members = [Address.parse(a) for a in args]
+
+    replica = (AtomixReplica.builder(address, members)
+               .with_transport(TcpTransport())
+               .build())
+    await replica.open()
+
+    group = await replica.get("group", DistributedMembershipGroup)
+    group.on_join(lambda m: print(f"member joined: {m.id}"))
+    group.on_leave(lambda m: print(f"member left: {m}"))
+    me = await group.join()
+    print(f"{address} joined as member {me.id}")
+    print("members:", [m.id for m in await group.members()])
+
+    while True:
+        await asyncio.sleep(10)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,54 @@
+"""Leader election example (reference ``LeaderElectionExample.java:28``).
+
+Run one replica per terminal over real TCP:
+
+    python examples/leader_election.py 127.0.0.1:5001 127.0.0.1:5002 127.0.0.1:5003
+    python examples/leader_election.py 127.0.0.1:5002 127.0.0.1:5001 127.0.0.1:5003
+    python examples/leader_election.py 127.0.0.1:5003 127.0.0.1:5001 127.0.0.1:5002
+
+First argv is this node's address; the rest are peers.  Each node joins the
+election; when elected it prints so and verifies its epoch periodically.
+"""
+
+import asyncio
+import sys
+
+sys.path.insert(0, ".")
+
+from copycat_tpu.coordination import DistributedLeaderElection
+from copycat_tpu.io.tcp import TcpTransport
+from copycat_tpu.io.transport import Address
+from copycat_tpu.manager.atomix import AtomixReplica
+
+
+async def main() -> None:
+    args = sys.argv[1:] or ["127.0.0.1:5001"]
+    address = Address.parse(args[0])
+    members = [Address.parse(a) for a in args]
+
+    replica = (AtomixReplica.builder(address, members)
+               .with_transport(TcpTransport())
+               .build())
+    await replica.open()
+    print(f"replica at {address} open")
+
+    election = await replica.get("election", DistributedLeaderElection)
+    epoch_holder = {}
+
+    def elected(epoch: int) -> None:
+        epoch_holder["epoch"] = epoch
+        print(f"{address} ELECTED leader, epoch={epoch}")
+
+    await election.on_election(elected)
+    print(f"{address} listening for leadership")
+
+    while True:
+        await asyncio.sleep(5)
+        epoch = epoch_holder.get("epoch")
+        if epoch is not None:
+            still = await election.is_leader(epoch)
+            print(f"{address} leadership check (epoch {epoch}): {still}")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,39 @@
+"""Standalone server example (reference ``StandaloneServerExample.java:27``):
+a pure server node with disk storage and small segments.
+
+    python examples/standalone_server.py 127.0.0.1:5001 [peers...]
+"""
+
+import asyncio
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+
+from copycat_tpu.io.tcp import TcpTransport
+from copycat_tpu.io.transport import Address
+from copycat_tpu.manager.atomix import AtomixServer
+from copycat_tpu.server.log import Storage, StorageLevel
+
+
+async def main() -> None:
+    args = sys.argv[1:] or ["127.0.0.1:5001"]
+    address = Address.parse(args[0])
+    members = [Address.parse(a) for a in args]
+
+    storage = Storage(StorageLevel.DISK,
+                      directory=tempfile.mkdtemp(prefix="copycat-tpu-"),
+                      max_entries_per_segment=16)
+    server = (AtomixServer.builder(address, members)
+              .with_transport(TcpTransport())
+              .with_storage(storage)
+              .build())
+    await server.open()
+    print(f"server listening at {address} (log: {storage.directory})")
+
+    while True:
+        await asyncio.sleep(10)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

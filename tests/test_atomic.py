@@ -1,0 +1,101 @@
+"""Atomic resource tests (reference ``DistributedAtomicValueTest``/
+``DistributedAtomicLongTest``)."""
+
+import asyncio
+
+from copycat_tpu.atomic import DistributedAtomicLong, DistributedAtomicValue
+
+from atomix_fixtures import Stack
+from helpers import async_test
+
+
+@async_test(timeout=90)
+async def test_atomic_value_set_get_cas():
+    stack = await Stack().start(3)
+    try:
+        client = await stack.client()
+        value = await client.get("value", DistributedAtomicValue)
+        assert await value.get() is None
+        await value.set("a")
+        assert await value.get() == "a"
+        assert await value.get_and_set("b") == "a"
+        assert await value.compare_and_set("b", "c") is True
+        assert await value.compare_and_set("b", "d") is False
+        assert await value.get() == "c"
+    finally:
+        await stack.close()
+
+
+@async_test(timeout=90)
+async def test_atomic_value_ttl():
+    stack = await Stack().start(3)
+    try:
+        client = await stack.client()
+        value = await client.get("ttl-value", DistributedAtomicValue)
+        await value.set("temp", ttl=0.3)
+        assert await value.get() == "temp"
+        await asyncio.sleep(0.9)
+        assert await value.get() is None
+    finally:
+        await stack.close()
+
+
+@async_test(timeout=90)
+async def test_atomic_value_change_events():
+    stack = await Stack().start(3)
+    try:
+        c1 = await stack.client()
+        c2 = await stack.client()
+        v1 = await c1.get("watched", DistributedAtomicValue)
+        v2 = await c2.get("watched", DistributedAtomicValue)
+        changes: list = []
+        got = asyncio.Event()
+
+        async def setup():
+            await v2.on_change(lambda v: (changes.append(v), got.set()))
+
+        await setup()
+        await v1.set("ping")
+        await asyncio.wait_for(got.wait(), 5)
+        assert changes == ["ping"]
+    finally:
+        await stack.close()
+
+
+@async_test(timeout=90)
+async def test_atomic_long_counter_ops():
+    """Reference DistributedAtomicLongTest: the 6 counter ops."""
+    stack = await Stack().start(3)
+    try:
+        client = await stack.client()
+        counter = await client.get("counter", DistributedAtomicLong)
+        assert await counter.increment_and_get() == 1
+        assert await counter.increment_and_get() == 2
+        assert await counter.decrement_and_get() == 1
+        assert await counter.get_and_increment() == 1
+        assert await counter.get_and_decrement() == 2
+        assert await counter.add_and_get(10) == 11
+        assert await counter.get_and_add(-1) == 11
+        assert await counter.get() == 10
+    finally:
+        await stack.close()
+
+
+@async_test(timeout=120)
+async def test_atomic_long_contended_cas():
+    """Two clients racing increments: CAS-retry must not lose updates."""
+    stack = await Stack().start(3)
+    try:
+        c1 = await stack.client()
+        c2 = await stack.client()
+        l1 = await c1.get("contended", DistributedAtomicLong)
+        l2 = await c2.get("contended", DistributedAtomicLong)
+
+        async def bump(counter, n):
+            for _ in range(n):
+                await counter.increment_and_get()
+
+        await asyncio.gather(bump(l1, 10), bump(l2, 10))
+        assert await l1.get() == 20
+    finally:
+        await stack.close()

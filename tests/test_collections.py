@@ -1,0 +1,154 @@
+"""Collection tests (reference ``DistributedMapTest`` incl. TTL expiry,
+``DistributedMultiMapTest``, ``DistributedSetTest``, ``DistributedQueueTest``)."""
+
+import asyncio
+
+import pytest
+
+from copycat_tpu.client.client import ApplicationError
+from copycat_tpu.collections import (
+    DistributedMap,
+    DistributedMultiMap,
+    DistributedQueue,
+    DistributedSet,
+)
+
+from atomix_fixtures import Stack
+from helpers import async_test
+
+
+@async_test(timeout=120)
+async def test_map_basic_ops():
+    stack = await Stack().start(3)
+    try:
+        client = await stack.client()
+        m = await client.get("map", DistributedMap)
+        assert await m.is_empty()
+        assert await m.put("a", 1) is None
+        assert await m.put("a", 2) == 1
+        assert await m.get("a") == 2
+        assert await m.get_or_default("zz", 9) == 9
+        assert await m.contains_key("a")
+        assert not await m.contains_key("b")
+        assert await m.contains_value(2)
+        assert await m.put_if_absent("a", 99) == 2
+        assert await m.put_if_absent("b", 3) is None
+        assert await m.size() == 2
+        assert await m.replace("a", 5) == 2
+        assert await m.replace("zz", 5) is None
+        assert await m.replace_if_present("a", 5, 6) is True
+        assert await m.replace_if_present("a", 5, 7) is False
+        assert await m.remove_if_present("b", 999) is False
+        assert await m.remove_if_present("b", 3) is True
+        assert await m.remove("a") == 6
+        assert await m.remove("a") is None
+        assert await m.is_empty()
+    finally:
+        await stack.close()
+
+
+@async_test(timeout=120)
+async def test_map_ttl_expiry():
+    """Reference testMapPutTtl: value gone after expiry through the log clock."""
+    stack = await Stack().start(3)
+    try:
+        client = await stack.client()
+        m = await client.get("ttlmap", DistributedMap)
+        await m.put("k", "v", ttl=0.3)
+        assert await m.get("k") == "v"
+        await asyncio.sleep(0.9)
+        assert await m.get("k") is None
+        await m.put_if_absent("k2", "v2", ttl=0.3)
+        await asyncio.sleep(0.9)
+        assert await m.get("k2") is None
+    finally:
+        await stack.close()
+
+
+@async_test(timeout=120)
+async def test_map_clear():
+    stack = await Stack().start(3)
+    try:
+        client = await stack.client()
+        m = await client.get("clearmap", DistributedMap)
+        await m.put("x", 1)
+        await m.put("y", 2)
+        await m.clear()
+        assert await m.is_empty()
+    finally:
+        await stack.close()
+
+
+@async_test(timeout=120)
+async def test_multimap_ops():
+    stack = await Stack().start(3)
+    try:
+        client = await stack.client()
+        mm = await client.get("mmap", DistributedMultiMap)
+        assert await mm.put("k", 1)
+        assert await mm.put("k", 2)
+        assert not await mm.put("k", 1)  # duplicate entry
+        assert sorted(await mm.get("k")) == [1, 2]
+        assert await mm.size("k") == 2
+        assert await mm.size() == 2
+        assert await mm.contains_key("k")
+        assert await mm.contains_entry("k", 2)
+        assert await mm.contains_value(1)
+        assert await mm.remove("k", 1) is True
+        assert await mm.remove("k", 1) is False
+        assert await mm.get("k") == [2]
+        removed = await mm.remove("k")
+        assert removed == [2]
+        assert await mm.is_empty()
+    finally:
+        await stack.close()
+
+
+@async_test(timeout=120)
+async def test_set_ops():
+    stack = await Stack().start(3)
+    try:
+        client = await stack.client()
+        s = await client.get("set", DistributedSet)
+        assert await s.add("x")
+        assert not await s.add("x")
+        assert await s.contains("x")
+        assert await s.size() == 1
+        assert await s.remove("x")
+        assert not await s.remove("x")
+        assert await s.is_empty()
+        # TTL member
+        await s.add("temp", ttl=0.3)
+        assert await s.contains("temp")
+        await asyncio.sleep(0.9)
+        assert not await s.contains("temp")
+    finally:
+        await stack.close()
+
+
+@async_test(timeout=120)
+async def test_queue_fifo_and_errors():
+    stack = await Stack().start(3)
+    try:
+        client = await stack.client()
+        q = await client.get("queue", DistributedQueue)
+        assert await q.is_empty()
+        await q.add("first")
+        await q.offer("second")
+        assert await q.peek() == "first"
+        assert await q.element() == "first"
+        assert await q.size() == 2
+        assert await q.contains("second")
+        assert await q.poll() == "first"
+        assert await q.remove() == "second"  # head removal
+        assert await q.poll() is None  # poll on empty -> None
+        with pytest.raises(ApplicationError):  # element on empty -> raises
+            await q.element()
+        await q.add("a")
+        await q.add("b")
+        assert await q.remove("a") is True  # remove by value
+        assert await q.remove("zz") is False
+        await q.clear()
+        assert await q.is_empty()
+    finally:
+        await stack.close()

@@ -1,0 +1,230 @@
+"""Coordination tests (reference ``DistributedLockTest``,
+``DistributedLeaderElectionTest`` incl. testNextElection,
+``DistributedMembershipGroupTest``, ``DistributedTopicTest``,
+``DistributedMessageBusTest.testSend``)."""
+
+import asyncio
+
+from copycat_tpu.coordination import (
+    DistributedLeaderElection,
+    DistributedLock,
+    DistributedMembershipGroup,
+    DistributedMessageBus,
+    DistributedTopic,
+)
+from copycat_tpu.io.local import LocalTransport
+from copycat_tpu.io.transport import Address
+
+from atomix_fixtures import Stack
+from helpers import async_test
+from raft_fixtures import next_ports
+
+
+@async_test(timeout=120)
+async def test_lock_unlock():
+    stack = await Stack().start(3)
+    try:
+        c1 = await stack.client()
+        c2 = await stack.client()
+        l1 = await c1.get("lock", DistributedLock)
+        l2 = await c2.get("lock", DistributedLock)
+        await l1.lock()
+        # Second holder must wait.
+        assert await l2.try_lock() is False
+        waiter = asyncio.ensure_future(l2.lock())
+        await asyncio.sleep(0.2)
+        assert not waiter.done()
+        await l1.unlock()
+        await asyncio.wait_for(waiter, 5)  # grant flows via session event
+        await l2.unlock()
+        # Re-acquirable after release.
+        assert await l1.try_lock() is True
+        await l1.unlock()
+    finally:
+        await stack.close()
+
+
+@async_test(timeout=120)
+async def test_lock_timeout():
+    stack = await Stack().start(3)
+    try:
+        c1 = await stack.client()
+        c2 = await stack.client()
+        l1 = await c1.get("tlock", DistributedLock)
+        l2 = await c2.get("tlock", DistributedLock)
+        await l1.lock()
+        # Bounded wait times out through the replicated clock.
+        assert await asyncio.wait_for(l2.try_lock(0.3), 10) is False
+        await l1.unlock()
+    finally:
+        await stack.close()
+
+
+@async_test(timeout=120)
+async def test_lock_released_on_session_expiry():
+    """Capability fix over the reference: holder crash releases the lock."""
+    stack = await Stack().start(3, session_timeout=0.8)
+    try:
+        c1 = await stack.client(session_timeout=0.8)
+        c2 = await stack.client(session_timeout=3.0)
+        l1 = await c1.get("xlock", DistributedLock)
+        l2 = await c2.get("xlock", DistributedLock)
+        await l1.lock()
+        waiter = asyncio.ensure_future(l2.lock())
+        await asyncio.sleep(0.1)
+        # Crash client 1 (no graceful close - keepalives just stop).
+        c1.client._keepalive.cancel()
+        c1.client._session.state = "expired"
+        await asyncio.wait_for(waiter, 15)  # lock re-granted to client 2
+        await l2.unlock()
+    finally:
+        await stack.close()
+
+
+@async_test(timeout=120)
+async def test_leader_election_and_failover():
+    """Reference testElection + testNextElection."""
+    stack = await Stack().start(3, session_timeout=0.8)
+    try:
+        c1 = await stack.client(session_timeout=0.8)
+        c2 = await stack.client(session_timeout=3.0)
+        e1 = await c1.get("election", DistributedLeaderElection)
+        e2 = await c2.get("election", DistributedLeaderElection)
+
+        elected1 = asyncio.Event()
+        elected2 = asyncio.Event()
+        epochs: dict = {}
+
+        def on1(epoch):
+            epochs[1] = epoch
+            elected1.set()
+
+        def on2(epoch):
+            epochs[2] = epoch
+            elected2.set()
+
+        await e1.on_election(on1)
+        await asyncio.wait_for(elected1.wait(), 5)
+        assert await e1.is_leader(epochs[1]) is True
+
+        await e2.on_election(on2)
+        await asyncio.sleep(0.2)
+        assert not elected2.is_set()  # second listener waits
+
+        # Kill the leader's client; leadership must pass to listener 2.
+        c1.client._keepalive.cancel()
+        c1.client._session.state = "expired"
+        await asyncio.wait_for(elected2.wait(), 15)
+        assert await e2.is_leader(epochs[2]) is True
+        # Old epoch is no longer valid (fencing).
+        assert await e2.is_leader(epochs[1]) is False
+    finally:
+        await stack.close()
+
+
+@async_test(timeout=120)
+async def test_membership_group_join_leave_events():
+    stack = await Stack().start(3)
+    try:
+        c1 = await stack.client()
+        c2 = await stack.client()
+        g1 = await c1.get("group", DistributedMembershipGroup)
+        g2 = await c2.get("group", DistributedMembershipGroup)
+
+        joins: list = []
+        leaves: list = []
+        joined = asyncio.Event()
+        left = asyncio.Event()
+        g1.on_join(lambda m: (joins.append(m.id), joined.set()))
+        g1.on_leave(lambda m: (leaves.append(m), left.set()))
+
+        me1 = await g1.join()
+        me2 = await g2.join()
+        await asyncio.wait_for(joined.wait(), 5)
+        assert joins == [me2.id]
+        assert {m.id for m in await g1.members()} == {me1.id, me2.id}
+
+        await g2.leave()
+        await asyncio.wait_for(left.wait(), 5)
+        assert leaves == [me2.id]
+    finally:
+        await stack.close()
+
+
+@async_test(timeout=120)
+async def test_membership_group_remote_execute():
+    """Remote execution via registered callback names (closure-free)."""
+    stack = await Stack().start(3)
+    try:
+        c1 = await stack.client()
+        c2 = await stack.client()
+        g1 = await c1.get("exec-group", DistributedMembershipGroup)
+        g2 = await c2.get("exec-group", DistributedMembershipGroup)
+
+        ran = asyncio.Event()
+        payloads: list = []
+        g2.handler("record", lambda x: (payloads.append(x), ran.set()))
+
+        await g1.join()
+        me2 = await g2.join()
+        assert await g1.member(me2.id).execute("record", "hello") is True
+        await asyncio.wait_for(ran.wait(), 5)
+        assert payloads == ["hello"]
+
+        # Scheduled execution through the deterministic timer wheel.
+        ran.clear()
+        assert await g1.member(me2.id).schedule(0.3, "record", "later") is True
+        await asyncio.wait_for(ran.wait(), 10)
+        assert payloads == ["hello", "later"]
+    finally:
+        await stack.close()
+
+
+@async_test(timeout=120)
+async def test_topic_pub_sub():
+    stack = await Stack().start(3)
+    try:
+        c1 = await stack.client()
+        c2 = await stack.client()
+        t1 = await c1.get("topic", DistributedTopic)
+        t2 = await c2.get("topic", DistributedTopic)
+
+        messages: list = []
+        got = asyncio.Event()
+        await t2.subscribe(lambda m: (messages.append(m), got.set()))
+        await t1.sync().publish("news")
+        await asyncio.wait_for(got.wait(), 5)
+        assert messages == ["news"]
+    finally:
+        await stack.close()
+
+
+@async_test(timeout=120)
+async def test_message_bus_direct_send():
+    """Reference DistributedMessageBusTest.testSend: registry via the log,
+    payload over a direct connection."""
+    stack = await Stack().start(3)
+    try:
+        c1 = await stack.client()
+        c2 = await stack.client()
+        b1 = await c1.get("bus", DistributedMessageBus)
+        b2 = await c2.get("bus", DistributedMessageBus)
+        addr1, addr2 = next_ports(2)
+        await b1.open(addr1, LocalTransport(stack.registry))
+        await b2.open(addr2, LocalTransport(stack.registry))
+
+        received: list = []
+        await b2.consumer("orders", lambda body: (received.append(body), "ack")[1])
+        # Registry propagation reaches b1 via session events.
+        for _ in range(100):
+            if "orders" in b1._consumers:
+                break
+            await asyncio.sleep(0.05)
+        producer = await b1.producer("orders")
+        reply = await producer.send({"sku": 7})
+        assert reply == "ack"
+        assert received == [{"sku": 7}]
+        await b1.close_bus()
+        await b2.close_bus()
+    finally:
+        await stack.close()

@@ -1,0 +1,255 @@
+"""Manager/facade tests (reference ``AtomixClientServerTest``/``AtomixReplicaTest``):
+full stack — AtomixServers + AtomixClients, inline test resource, consistency
+matrix, get-vs-create semantics, cross-node visibility, per-resource isolation.
+"""
+
+import asyncio
+
+import pytest
+
+from copycat_tpu.io.local import LocalServerRegistry, LocalTransport
+from copycat_tpu.io.serializer import serialize_with
+from copycat_tpu.io.buffer import BufferInput, BufferOutput
+from copycat_tpu.manager.atomix import AtomixClient, AtomixReplica, AtomixServer
+from copycat_tpu.protocol.operations import Command, Query
+from copycat_tpu.resource.consistency import Consistency
+from copycat_tpu.resource.resource import AbstractResource, resource_info
+from copycat_tpu.resource.state_machine import ResourceStateMachine
+from copycat_tpu.server.state_machine import Commit
+
+from helpers import async_test
+from raft_fixtures import next_ports
+
+
+@serialize_with(920)
+class EchoCommand(Command):
+    def __init__(self, value=None):
+        self.value = value
+
+    def write_object(self, buf, s):
+        s.write_object(self.value, buf)
+
+    def read_object(self, buf, s):
+        self.value = s.read_object(buf)
+
+
+@serialize_with(921)
+class EchoQuery(Query):
+    def __init__(self, value=None):
+        self.value = value
+
+    def write_object(self, buf, s):
+        s.write_object(self.value, buf)
+
+    def read_object(self, buf, s):
+        self.value = s.read_object(buf)
+
+
+@serialize_with(922)
+class SetValueCmd(Command):
+    def __init__(self, value=None):
+        self.value = value
+
+    def write_object(self, buf, s):
+        s.write_object(self.value, buf)
+
+    def read_object(self, buf, s):
+        self.value = s.read_object(buf)
+
+
+@serialize_with(923)
+class GetValueQry(Query):
+    def write_object(self, buf, s):
+        pass
+
+    def read_object(self, buf, s):
+        pass
+
+
+@serialize_with(924)
+class EchoStateMachine(ResourceStateMachine):
+    """Echo machine (reference inline EchoStateMachine)."""
+
+    def __init__(self):
+        super().__init__()
+        self.value = None
+
+    def echo_command(self, commit: Commit[EchoCommand]):
+        try:
+            return commit.operation.value
+        finally:
+            commit.clean()
+
+    def echo_query(self, commit: Commit[EchoQuery]):
+        try:
+            return commit.operation.value
+        finally:
+            commit.close()
+
+    def set_value(self, commit: Commit[SetValueCmd]):
+        self.value = commit.operation.value
+
+    def get_value(self, commit: Commit[GetValueQry]):
+        try:
+            return self.value
+        finally:
+            commit.close()
+
+
+@resource_info(state_machine=EchoStateMachine)
+class EchoResource(AbstractResource):
+    async def command(self, value):
+        return await self.submit(EchoCommand(value))
+
+    async def query(self, value):
+        return await self.submit(EchoQuery(value))
+
+
+@serialize_with(925)
+class ValueStateMachine(EchoStateMachine):
+    pass
+
+
+@resource_info(state_machine=ValueStateMachine)
+class ValueResource(AbstractResource):
+    async def set(self, value):
+        await self.submit(SetValueCmd(value))
+
+    async def get(self):
+        return await self.submit(GetValueQry())
+
+
+async def _servers(n=3, registry=None, session_timeout=3.0):
+    registry = registry or LocalServerRegistry()
+    addrs = next_ports(n)
+    servers = [
+        AtomixServer(a, addrs, LocalTransport(registry),
+                     election_timeout=0.2, heartbeat_interval=0.04,
+                     session_timeout=session_timeout)
+        for a in addrs
+    ]
+    await asyncio.gather(*(s.open() for s in servers))
+    return servers, addrs, registry
+
+
+async def _teardown(nodes):
+    for node in nodes:
+        try:
+            await asyncio.wait_for(node.close(), 5)
+        except (Exception, asyncio.TimeoutError):
+            pass
+
+
+@async_test(timeout=90)
+async def test_client_server_all_consistency_levels():
+    servers, addrs, registry = await _servers(3)
+    client = AtomixClient(addrs, LocalTransport(registry), session_timeout=3.0)
+    await client.open()
+    try:
+        resource = await client.get("test", EchoResource)
+        for level in (Consistency.NONE, Consistency.PROCESS,
+                      Consistency.SEQUENTIAL, Consistency.ATOMIC):
+            resource.with_consistency(level)
+            assert await resource.command(f"c-{level.value}") == f"c-{level.value}"
+            assert await resource.query(f"q-{level.value}") == f"q-{level.value}"
+    finally:
+        await _teardown([client] + servers)
+
+
+@async_test(timeout=90)
+async def test_get_shares_state_create_is_distinct_session():
+    servers, addrs, registry = await _servers(3)
+    client = AtomixClient(addrs, LocalTransport(registry), session_timeout=3.0)
+    await client.open()
+    try:
+        # Two gets of the same key share the node-local instance.
+        r1 = await client.get("shared", ValueResource)
+        r2 = await client.get("shared", ValueResource)
+        assert r1 is r2
+        # create() yields a distinct instance (unique virtual session) over the
+        # same replicated state.
+        r3 = await client.create("shared", ValueResource)
+        assert r3 is not r1
+        assert r3.client.instance_id != r1.client.instance_id
+        await r1.set("from-get")
+        assert await r3.get() == "from-get"
+    finally:
+        await _teardown([client] + servers)
+
+
+@async_test(timeout=90)
+async def test_cross_client_visibility():
+    servers, addrs, registry = await _servers(3)
+    c1 = AtomixClient(addrs, LocalTransport(registry), session_timeout=3.0)
+    c2 = AtomixClient(addrs, LocalTransport(registry), session_timeout=3.0)
+    await c1.open()
+    await c2.open()
+    try:
+        r1 = await c1.get("xnode", ValueResource)
+        r2 = await c2.get("xnode", ValueResource)
+        await r1.set(42)
+        assert await r2.get() == 42
+    finally:
+        await _teardown([c1, c2] + servers)
+
+
+@async_test(timeout=90)
+async def test_exists_and_delete():
+    servers, addrs, registry = await _servers(3)
+    client = AtomixClient(addrs, LocalTransport(registry), session_timeout=3.0)
+    await client.open()
+    try:
+        assert not await client.exists("gone")
+        resource = await client.get("gone", ValueResource)
+        assert await client.exists("gone")
+        await resource.delete()
+        assert not await client.exists("gone")
+    finally:
+        await _teardown([client] + servers)
+
+
+@async_test(timeout=90)
+async def test_replicas_operate_many_isolated_resources():
+    """Reference AtomixReplicaTest.testOperateMany: distinct keys on distinct
+    replicas stay isolated over the shared log."""
+    registry = LocalServerRegistry()
+    addrs = next_ports(3)
+    replicas = [
+        AtomixReplica(a, addrs, LocalTransport(registry),
+                      election_timeout=0.2, heartbeat_interval=0.04,
+                      session_timeout=3.0)
+        for a in addrs
+    ]
+    await asyncio.gather(*(r.open() for r in replicas))
+    try:
+        ra = await replicas[0].get("alpha", ValueResource)
+        rb = await replicas[1].get("beta", ValueResource)
+        await ra.set("A")
+        await rb.set("B")
+        ra2 = await replicas[2].get("alpha", ValueResource)
+        rb2 = await replicas[2].get("beta", ValueResource)
+        assert await ra2.get() == "A"
+        assert await rb2.get() == "B"
+    finally:
+        await _teardown(replicas)
+
+
+@async_test(timeout=90)
+async def test_wrong_type_for_existing_key_fails():
+    from copycat_tpu.client.client import ApplicationError
+
+    servers, addrs, registry = await _servers(3)
+    client = AtomixClient(addrs, LocalTransport(registry), session_timeout=3.0)
+    client2 = AtomixClient(addrs, LocalTransport(registry), session_timeout=3.0)
+    await client.open()
+    await client2.open()
+    try:
+        await client.get("typed", ValueResource)
+        # Same node: rejected by the local singleton cache.
+        with pytest.raises(ValueError, match="already open"):
+            await client.get("typed", EchoResource)
+        # Different node: rejected by the replicated catalog.
+        with pytest.raises(ApplicationError, match="exists with type"):
+            await client2.get("typed", EchoResource)
+    finally:
+        await _teardown([client, client2] + servers)
