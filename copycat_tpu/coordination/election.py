@@ -28,17 +28,26 @@ class DistributedLeaderElection(AbstractResource):
     async def on_election(self, callback: Callable[[int], Any]) -> Listener:
         """Register for leadership; ``callback(epoch)`` fires when this
         instance becomes leader."""
+        # The callback must be registered BEFORE the submit: with ATOMIC
+        # consistency the "elect" event reaches us before the Listen response
+        # (events-before-response, reference Consistency.java:157-176).
         listener = self._listeners.add(callback)
         if not self._listening:
             self._listening = True
-            await self.submit(c.ElectionListen())
+            try:
+                await self.submit(c.ElectionListen())
+            except BaseException:
+                # Roll back so a retry re-submits instead of wedging.
+                self._listening = False
+                listener.close()
+                raise
         return listener
 
     async def resign(self) -> None:
         """Give up leadership / candidacy (submits Unlisten)."""
         if self._listening:
-            self._listening = False
             await self.submit(c.ElectionUnlisten())
+            self._listening = False
 
     async def is_leader(self, epoch: int) -> bool:
         """Validate a fencing token against current leadership."""

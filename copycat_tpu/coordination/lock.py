@@ -1,13 +1,13 @@
 """Distributed mutex (reference ``DistributedLock.java:58``).
 
 The grant is delivered as a session EVENT, not the command response: the
-client queues a waiter future and completes it when the "lock" event arrives
-(in FIFO order matching the server queue)."""
+client registers a waiter future and completes it when the matching "lock"
+event arrives. Events carry the waiter id (the Lock commit's index, also the
+command result) so out-of-FIFO timeout events resolve the RIGHT waiter."""
 
 from __future__ import annotations
 
 import asyncio
-from collections import deque
 from typing import Any
 
 from ..resource.resource import AbstractResource, resource_info
@@ -19,27 +19,28 @@ from .state import LockState
 class DistributedLock(AbstractResource):
     def __init__(self, client: Any) -> None:
         super().__init__(client)
-        self._waiters: deque[asyncio.Future] = deque()
+        self._waiters: dict[int, asyncio.Future] = {}
+        # Grants can arrive BEFORE the submit response that tells us our id
+        # (events-before-response for LINEARIZABLE commands): buffer them.
+        self._early_events: dict[int, bool] = {}
         self.session().on_event("lock", self._on_lock_event)
 
-    def _on_lock_event(self, acquired: bool) -> None:
-        while self._waiters:
-            fut = self._waiters.popleft()
+    def _on_lock_event(self, event: dict) -> None:
+        waiter_id, acquired = int(event["id"]), bool(event["acquired"])
+        fut = self._waiters.pop(waiter_id, None)
+        if fut is not None:
             if not fut.done():
-                fut.set_result(bool(acquired))
-                return
+                fut.set_result(acquired)
+        else:
+            self._early_events[waiter_id] = acquired
 
     async def _submit_lock(self, timeout: float) -> asyncio.Future:
-        """Queue a waiter and submit; on submit failure the waiter is removed
-        so a later grant cannot resolve a stale future out of order."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._waiters.append(fut)
-        try:
-            await self.submit(c.Lock(timeout=timeout))
-        except BaseException:
-            if fut in self._waiters:
-                self._waiters.remove(fut)
-            raise
+        waiter_id = int(await self.submit(c.Lock(timeout=timeout)))
+        if waiter_id in self._early_events:
+            fut.set_result(self._early_events.pop(waiter_id))
+        else:
+            self._waiters[waiter_id] = fut
         return fut
 
     async def lock(self) -> None:

@@ -28,26 +28,32 @@ class LockState(ResourceStateMachine):
         self._queue: deque[Commit] = deque()
         self._timers: dict[int, Any] = {}  # commit index -> timer
 
-    def lock(self, commit: Commit[c.Lock]) -> None:
+    def lock(self, commit: Commit[c.Lock]) -> int:
+        # The command result is the waiter id (= commit index); every "lock"
+        # event carries it so the client resolves the RIGHT waiter even when
+        # timeouts fire out of FIFO order (a short try_lock queued behind an
+        # unbounded lock can expire before the grant).
         if self._holder is None:
             self._holder = commit
-            commit.session.publish("lock", True)
-            return
+            commit.session.publish("lock", {"id": commit.index, "acquired": True})
+            return commit.index
         timeout = commit.operation.timeout
         if timeout == 0:
-            commit.session.publish("lock", False)
+            commit.session.publish("lock", {"id": commit.index, "acquired": False})
             commit.clean()
-            return
+            return commit.index
         self._queue.append(commit)
         if timeout and timeout > 0:
             def expire() -> None:
                 self._timers.pop(commit.index, None)
                 if commit in self._queue:
                     self._queue.remove(commit)
-                    commit.session.publish("lock", False)
+                    commit.session.publish(
+                        "lock", {"id": commit.index, "acquired": False})
                     commit.clean()
 
             self._timers[commit.index] = self.executor.schedule(timeout, expire)
+        return commit.index
 
     def unlock(self, commit: Commit[c.Unlock]) -> None:
         try:
@@ -70,7 +76,7 @@ class LockState(ResourceStateMachine):
                 timer.cancel()
             if waiter.session.is_open:
                 self._holder = waiter
-                waiter.session.publish("lock", True)
+                waiter.session.publish("lock", {"id": waiter.index, "acquired": True})
                 return
             waiter.clean()
 
@@ -224,7 +230,8 @@ class MembershipGroupState(ResourceStateMachine):
                 target.session.publish("execute", (op.callback, op.args))
             commit.clean()
 
-        self._timers[commit.index] = self.executor.schedule(op.delay or 0.0, fire)
+        self._timers[commit.index] = (
+            self.executor.schedule(op.delay or 0.0, fire), commit)
         return True
 
     def _remove_member(self, session_id: int) -> None:
@@ -240,8 +247,9 @@ class MembershipGroupState(ResourceStateMachine):
         self._remove_member(session.id)
 
     def delete(self) -> None:
-        for timer in self._timers.values():
+        for timer, pending in self._timers.values():
             timer.cancel()
+            pending.clean()  # fire() will never run to clean it
         self._timers.clear()
         for member in self._members.values():
             member.clean()
@@ -306,6 +314,9 @@ class MessageBusState(ResourceStateMachine):
         self._topics: dict[str, dict[int, Commit]] = {}  # topic -> session -> Register
 
     def join(self, commit: Commit[c.BusJoin]) -> dict:
+        previous = self._members.get(commit.session.id)
+        if previous is not None:
+            previous.clean()  # re-join supersedes the old registration
         self._members[commit.session.id] = commit
         # Snapshot: topic -> list of consumer addresses (reference join returns
         # the full registry so a new bus can dial existing consumers).

@@ -1,0 +1,8 @@
+"""Flagship device-resident models.
+
+``RaftGroups`` is the framework's flagship: every Raft group in the cluster
+batched into one XLA program (the TPU equivalent of the reference's
+one-``ResourceManager``-per-server design, ``AtomixReplica.java:374``).
+"""
+
+from .raft_groups import RaftGroups  # noqa: F401

@@ -1,0 +1,187 @@
+"""Host runtime around the batched consensus step.
+
+The reference hosts one state machine per server process and drives it with
+asyncio-style RPC (``CopycatServer``, consumed per SURVEY.md §2.3). Here the
+host owns G logical Raft groups living on device and drives them round by
+round: queue client ops, call the jitted step, harvest per-op results by
+correlation tag.
+
+This is the device executor the Resource/StateMachine SPI targets
+(SURVEY.md §7.1: "the TPU executor selectable at replica build time");
+the session protocol, exactly-once caching and event push stay host-side
+in ``copycat_tpu.server`` — the device provides ordered, replicated,
+deterministic apply at batch scale.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import apply as apply_ops
+from ..ops.consensus import (
+    Config,
+    RaftState,
+    StepOutputs,
+    Submits,
+    full_delivery,
+    init_state,
+    step,
+)
+
+
+class RaftGroups:
+    """G Raft groups × P peers, stepped as one compiled program."""
+
+    def __init__(
+        self,
+        num_groups: int,
+        num_peers: int = 3,
+        log_slots: int = 64,
+        submit_slots: int = 4,
+        config: Config | None = None,
+        seed: int = 0,
+        mesh: Any | None = None,
+    ) -> None:
+        self.num_groups = num_groups
+        self.num_peers = num_peers
+        self.log_slots = log_slots
+        self.submit_slots = submit_slots
+        self.config = config or Config()
+        self.mesh = mesh
+
+        key = jax.random.PRNGKey(seed)
+        self._key, init_key = jax.random.split(key)
+        self.state: RaftState = init_state(num_groups, num_peers, log_slots,
+                                           init_key, self.config)
+        self.deliver = full_delivery(num_groups, num_peers)
+        if mesh is not None:
+            from ..parallel import shard_state, shard_step_inputs
+            self.state = shard_state(self.state, mesh)
+            _, self.deliver = shard_step_inputs(
+                self._empty_submits(), self.deliver, mesh)
+
+        self._step = jax.jit(partial(step, config=self.config))
+        self._queues: dict[int, deque] = {}
+        self._next_tag = 1
+        self._inflight: dict[int, int] = {}  # tag -> group
+        self.results: dict[int, int] = {}    # tag -> result
+        self.rounds = 0
+
+    # -- op submission ---------------------------------------------------
+
+    def _empty_submits(self) -> Submits:
+        G, S = self.num_groups, self.submit_slots
+        return Submits(opcode=np.zeros((G, S), np.int32),
+                       a=np.zeros((G, S), np.int32),
+                       b=np.zeros((G, S), np.int32),
+                       tag=np.zeros((G, S), np.int32),
+                       valid=np.zeros((G, S), bool))
+
+    def submit(self, group: int, opcode: int, a: int = 0, b: int = 0) -> int:
+        """Queue one op; returns a correlation tag resolved in ``results``."""
+        tag = self._next_tag
+        self._next_tag += 1
+        self._queues.setdefault(group, deque()).append((opcode, a, b, tag))
+        self._inflight[tag] = group
+        return tag
+
+    def _build_submits(self) -> Submits:
+        sub = self._empty_submits()
+        if not self._queues:
+            return sub
+        for g, q in list(self._queues.items()):
+            for s in range(self.submit_slots):
+                if not q:
+                    break
+                opcode, a, b, tag = q.popleft()
+                sub.opcode[g, s] = opcode
+                sub.a[g, s] = a
+                sub.b[g, s] = b
+                sub.tag[g, s] = tag
+                sub.valid[g, s] = True
+            if not q:
+                del self._queues[g]
+        return sub
+
+    # -- stepping ----------------------------------------------------------
+
+    def step_round(self, submits: Submits | None = None,
+                   deliver: Any | None = None) -> StepOutputs:
+        """Advance every group one round; harvests results into ``results``."""
+        explicit = submits is not None
+        if submits is None:
+            submits = self._build_submits()
+        self._key, key = jax.random.split(self._key)
+        self.state, out = self._step(
+            self.state, submits,
+            self.deliver if deliver is None else deliver, key)
+        self.rounds += 1
+        if not explicit:
+            self._requeue_rejected(submits, out)
+            self._harvest(out)
+        return out
+
+    def _requeue_rejected(self, submits: Submits, out: StepOutputs) -> None:
+        acc = np.asarray(out.accepted)
+        valid = np.asarray(submits.valid)
+        rejected = valid & ~acc
+        if not rejected.any():
+            return
+        # appendleft in REVERSE slot order so retried ops keep submission order
+        for g, s in reversed(list(zip(*np.nonzero(rejected)))):
+            self._queues.setdefault(int(g), deque()).appendleft(
+                (int(submits.opcode[g, s]), int(submits.a[g, s]),
+                 int(submits.b[g, s]), int(submits.tag[g, s])))
+
+    def _harvest(self, out: StepOutputs) -> None:
+        valid = np.asarray(out.out_valid)
+        if not valid.any():
+            return
+        tags = np.asarray(out.out_tag)
+        res = np.asarray(out.out_result)
+        for g, i in zip(*np.nonzero(valid)):
+            tag = int(tags[g, i])
+            if tag and tag in self._inflight:
+                del self._inflight[tag]
+                self.results[tag] = int(res[g, i])
+
+    def run(self, rounds: int) -> None:
+        for _ in range(rounds):
+            self.step_round()
+
+    def run_until(self, tags: list[int], max_rounds: int = 200) -> None:
+        """Step until all given tags have results (or raise)."""
+        for _ in range(max_rounds):
+            if all(t in self.results for t in tags):
+                return
+            self.step_round()
+        missing = [t for t in tags if t not in self.results]
+        raise TimeoutError(f"ops not committed after {max_rounds} rounds: {missing}")
+
+    def wait_for_leaders(self, max_rounds: int = 100) -> np.ndarray:
+        """Step until every group has a leader; returns leader indices [G]."""
+        for _ in range(max_rounds):
+            out = self.step_round()
+            leaders = np.asarray(out.leader)
+            if (leaders >= 0).all():
+                return leaders
+        raise TimeoutError(f"not all groups elected a leader in {max_rounds} rounds")
+
+    # -- inspection --------------------------------------------------------
+
+    def leader(self, group: int) -> int:
+        role = np.asarray(self.state.role[group])
+        term = np.asarray(self.state.term[group])
+        leaders = np.nonzero(role == 2)[0]
+        if len(leaders) == 0:
+            return -1
+        return int(leaders[np.argmax(term[leaders])])
+
+    def value(self, group: int, peer: int = 0) -> int:
+        return int(self.state.resources.value[group, peer])

@@ -1,0 +1,20 @@
+"""TPU-native consensus kernels.
+
+All Raft groups are batched into fixed-shape ``[num_groups, num_peers]``
+tensors and stepped as ONE jitted XLA program per synchronous round:
+election vote tallies, AppendEntries log-matching, quorum commit advance,
+and vectorized state-machine apply (SURVEY.md §7.1).
+"""
+
+from .consensus import (  # noqa: F401
+    CANDIDATE,
+    FOLLOWER,
+    LEADER,
+    RaftState,
+    StepOutputs,
+    Submits,
+    init_state,
+    make_submits,
+    step,
+)
+from . import apply  # noqa: F401

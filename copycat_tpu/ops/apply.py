@@ -1,0 +1,84 @@
+"""Vectorized state-machine apply kernels.
+
+The reference applies one commit at a time through per-resource executors
+(``ResourceManager.operateResource``, ``ResourceManager.java:56``;
+``AtomicValueState.java:32``). Here the same op semantics are data — an
+opcode plus two int32 arguments — applied to ALL groups' replicas at once
+with ``jnp.where`` masking, so XLA vectorizes the apply across the
+``[num_groups, num_peers]`` batch instead of dispatching per commit.
+
+Only fixed-width state lives on device. Arbitrary Python payloads take the
+CPU oracle path (``copycat_tpu.server``); the device path covers the hot,
+fixed-shape resource kernels (BASELINE.md configs).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+# --- opcodes (device-path operation catalog) -------------------------------
+# Mirrors the reference's serializer-id catalogs (AtomicValueCommands ids
+# 50-55 etc.) as a dense opcode space.
+OP_NOP = 0
+OP_VALUE_SET = 1
+OP_VALUE_GET = 2
+OP_VALUE_CAS = 3          # a=expect, b=update -> result: 1 if swapped else 0
+OP_VALUE_GET_AND_SET = 4  # a=update -> result: previous value
+OP_LONG_ADD = 5           # a=delta -> result: new value (addAndGet)
+
+
+class ResourceState(NamedTuple):
+    """Per-group, per-replica device-resident resource state.
+
+    Every field is ``[num_groups, num_peers, ...]``: each replica applies the
+    same committed ops in the same order, so replica states stay identical —
+    exactly the reference's replicated-state-machine discipline, kept as a
+    batch dimension so divergence is *testable* (see tests).
+    """
+
+    value: jnp.ndarray  # [G, P] int32 — AtomicValue/AtomicLong register
+
+
+def init_resources(num_groups: int, num_peers: int) -> ResourceState:
+    return ResourceState(
+        value=jnp.zeros((num_groups, num_peers), jnp.int32),
+    )
+
+
+def apply_entry(
+    res: ResourceState,
+    opcode: jnp.ndarray,  # [G, P] int32
+    a: jnp.ndarray,       # [G, P] int32
+    b: jnp.ndarray,       # [G, P] int32
+    live: jnp.ndarray,    # [G, P] bool — entry exists and is being applied
+) -> tuple[ResourceState, jnp.ndarray]:
+    """Apply one committed entry per (group, replica) lane.
+
+    Returns ``(new_state, result)`` where ``result`` is the int32 command
+    response for the lane (meaningful only where ``live``).
+    """
+    value = res.value
+
+    is_set = live & (opcode == OP_VALUE_SET)
+    is_get = live & (opcode == OP_VALUE_GET)
+    is_cas = live & (opcode == OP_VALUE_CAS)
+    is_gas = live & (opcode == OP_VALUE_GET_AND_SET)
+    is_add = live & (opcode == OP_LONG_ADD)
+
+    cas_hit = is_cas & (value == a)
+
+    new_value = value
+    new_value = jnp.where(is_set, a, new_value)
+    new_value = jnp.where(cas_hit, b, new_value)
+    new_value = jnp.where(is_gas, a, new_value)
+    new_value = jnp.where(is_add, value + a, new_value)
+
+    result = jnp.zeros_like(value)
+    result = jnp.where(is_get, value, result)
+    result = jnp.where(is_cas, cas_hit.astype(jnp.int32), result)
+    result = jnp.where(is_gas, value, result)
+    result = jnp.where(is_add, new_value, result)
+
+    return res._replace(value=new_value), result

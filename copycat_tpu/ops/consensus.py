@@ -1,0 +1,425 @@
+"""Batched Raft consensus as one jitted XLA step.
+
+The reference's consensus core (external Copycat, consumed per SURVEY.md §2.3)
+runs one Raft group per server over asyncio-style RPC. Here ALL groups run at
+once: state is ``[num_groups, num_peers]`` tensors and one ``step()`` call
+advances every group by one synchronous message round —
+
+1. client ops are injected into leader logs,
+2. leaders send AppendEntries (log-matching check, ring-buffer entry copy),
+3. acks update matchIndex, quorum sort advances commitIndex,
+4. election timers fire, RequestVote tallies elect leaders,
+5. committed entries are applied through the vectorized resource kernels.
+
+Quorum tallies are sums over the peer axis; when the peer axis is sharded
+over a ``jax.sharding.Mesh`` those sums become ICI collectives (XLA inserts
+them from the sharding annotations — see ``copycat_tpu.parallel``).
+
+Message loss is first-class: ``deliver[g, from, to]`` masks every exchange,
+so partitions/nemesis run *inside* the compiled step (SURVEY.md §4's
+"real consensus, fake network" strategy, on device).
+
+Safety properties preserved (tested in tests/test_tpu_consensus.py):
+ - election safety: ≤1 leader per (group, term) — single ``voted_for`` per
+   voter per term, deterministic lowest-index tie-break among candidates;
+ - log matching: AppendEntries carries (prevIndex, prevTerm); mismatch
+   rejects and rewinds nextIndex;
+ - leader completeness: vote granted only to candidates with up-to-date
+   logs (last term, last index) ≥ voter's;
+ - commit safety: commitIndex advances only onto entries of the leader's
+   current term (Raft §5.4.2 — a fresh leader appends a NoOp to unlock).
+
+The log is a fixed-capacity ring per replica (SURVEY.md §5.7): slot(i) =
+(i-1) mod L. Followers lagging beyond the ring window are flagged ``stale``
+and stop receiving (snapshot install catches them up — see
+``models/raft_groups.py``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .apply import ResourceState, apply_entry, init_resources
+
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+
+
+class RaftState(NamedTuple):
+    """Device-resident replicated state for G groups × P peers."""
+
+    term: jnp.ndarray          # [G,P] i32
+    voted_for: jnp.ndarray     # [G,P] i32, -1 = none
+    role: jnp.ndarray          # [G,P] i32 ∈ {FOLLOWER, CANDIDATE, LEADER}
+    leader_hint: jnp.ndarray   # [G,P] i32 peer index, -1 = unknown
+    timer: jnp.ndarray         # [G,P] i32 rounds until election timeout
+    last_index: jnp.ndarray    # [G,P] i32
+    commit_index: jnp.ndarray  # [G,P] i32
+    applied_index: jnp.ndarray  # [G,P] i32
+    next_index: jnp.ndarray    # [G,P,P] i32 (axis1 = owner-as-leader, axis2 = target)
+    match_index: jnp.ndarray   # [G,P,P] i32
+    log_term: jnp.ndarray      # [G,P,L] i32 ring
+    log_op: jnp.ndarray        # [G,P,L] i32 opcode
+    log_a: jnp.ndarray         # [G,P,L] i32 arg
+    log_b: jnp.ndarray         # [G,P,L] i32 arg
+    log_tag: jnp.ndarray       # [G,P,L] i32 host correlation tag
+    resources: ResourceState
+
+
+class Submits(NamedTuple):
+    """Client ops to inject this round, S slots per group."""
+
+    opcode: jnp.ndarray  # [G,S] i32
+    a: jnp.ndarray       # [G,S] i32
+    b: jnp.ndarray       # [G,S] i32
+    tag: jnp.ndarray     # [G,S] i32
+    valid: jnp.ndarray   # [G,S] bool
+
+
+class StepOutputs(NamedTuple):
+    accepted: jnp.ndarray    # [G,S] bool — submit made it into the leader log
+    out_valid: jnp.ndarray   # [G,A] bool — a command applied at the leader
+    out_tag: jnp.ndarray     # [G,A] i32
+    out_result: jnp.ndarray  # [G,A] i32
+    leader: jnp.ndarray      # [G] i32 leader peer at round start (-1 none)
+    commit_index: jnp.ndarray  # [G] i32 leader commit after the round
+    stale: jnp.ndarray       # [G,P] bool — lagging beyond ring window
+
+
+class Config(NamedTuple):
+    """Static step configuration (hashable → usable as a jit static arg)."""
+
+    append_window: int = 4    # entries per AppendEntries per round
+    applies_per_round: int = 4
+    timer_min: int = 4        # election timeout in rounds (randomized range)
+    timer_max: int = 9
+
+
+def init_state(num_groups: int, num_peers: int, log_slots: int,
+               key: jax.Array, config: Config = Config()) -> RaftState:
+    G, P, L = num_groups, num_peers, log_slots
+    z2 = jnp.zeros((G, P), jnp.int32)
+    z3 = jnp.zeros((G, P, P), jnp.int32)
+    zl = jnp.zeros((G, P, L), jnp.int32)
+    return RaftState(
+        term=z2, voted_for=z2 - 1, role=z2 + FOLLOWER, leader_hint=z2 - 1,
+        timer=jax.random.randint(key, (G, P), config.timer_min, config.timer_max),
+        last_index=z2, commit_index=z2, applied_index=z2,
+        next_index=z3 + 1, match_index=z3,
+        log_term=zl, log_op=zl, log_a=zl, log_b=zl, log_tag=zl,
+        resources=init_resources(G, P),
+    )
+
+
+def make_submits(num_groups: int, submit_slots: int) -> Submits:
+    G, S = num_groups, submit_slots
+    z = jnp.zeros((G, S), jnp.int32)
+    return Submits(opcode=z, a=z, b=z, tag=z, valid=jnp.zeros((G, S), bool))
+
+
+def full_delivery(num_groups: int, num_peers: int) -> jnp.ndarray:
+    return jnp.ones((num_groups, num_peers, num_peers), bool)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _peer_view(x: jnp.ndarray, lead: jnp.ndarray) -> jnp.ndarray:
+    """Gather x[g, lead[g], ...] → [G, ...] (lead clipped; mask separately)."""
+    idx = jnp.clip(lead, 0).reshape((x.shape[0],) + (1,) * (x.ndim - 1))
+    return jnp.take_along_axis(x, idx, axis=1).squeeze(1)
+
+
+def _term_at_2d(log_term: jnp.ndarray, last: jnp.ndarray,
+                idx: jnp.ndarray) -> jnp.ndarray:
+    """Term lookup on a [G,L] ring at idx [G,P] (0 outside the live window)."""
+    L = log_term.shape[-1]
+    slot = (idx - 1) % L
+    t = jnp.take_along_axis(log_term, slot, axis=1)
+    valid = (idx >= 1) & (idx <= last[:, None]) & (idx > last[:, None] - L)
+    return jnp.where(valid, t, 0)
+
+
+def _term_at_own(log_term: jnp.ndarray, last: jnp.ndarray,
+                 idx: jnp.ndarray) -> jnp.ndarray:
+    """Term lookup on each replica's own [G,P,L] ring at idx [G,P]."""
+    L = log_term.shape[-1]
+    slot = ((idx - 1) % L)[..., None]
+    t = jnp.take_along_axis(log_term, slot, axis=2).squeeze(-1)
+    valid = (idx >= 1) & (idx <= last) & (idx > last - L)
+    return jnp.where(valid, t, 0)
+
+
+def _scatter_lane(x: jnp.ndarray, lead: jnp.ndarray, active: jnp.ndarray,
+                  new: jnp.ndarray) -> jnp.ndarray:
+    """Write new[G,...] into x[G,P,...] at lane (g, lead[g]) where active."""
+    P = x.shape[1]
+    lane = (jnp.arange(P)[None, :] == lead[:, None]) & active[:, None]
+    lane = lane.reshape(lane.shape + (1,) * (x.ndim - 2))
+    return jnp.where(lane, jnp.expand_dims(new, 1), x)
+
+
+def _slot_write(log: jnp.ndarray, slot: jnp.ndarray, mask: jnp.ndarray,
+                value: jnp.ndarray) -> jnp.ndarray:
+    """Masked scatter value[G,P] into log[G,P,L] at slot[G,P]."""
+    L = log.shape[-1]
+    hit = (jnp.arange(L)[None, None, :] == slot[..., None]) & mask[..., None]
+    return jnp.where(hit, value[..., None], log)
+
+
+# ---------------------------------------------------------------------------
+# the step
+# ---------------------------------------------------------------------------
+
+def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
+         key: jax.Array, config: Config) -> tuple[RaftState, StepOutputs]:
+    """Advance every group by one synchronous consensus round."""
+    G, P = state.term.shape
+    L = state.log_term.shape[-1]
+    E = config.append_window
+    A = config.applies_per_round
+    quorum = P // 2 + 1
+    peer_ids = jnp.arange(P)
+    g_ids = jnp.arange(G)
+
+    # Self-delivery is always on (a node talks to itself).
+    deliver = deliver | jnp.eye(P, dtype=bool)[None]
+
+    # ---- current leader per group (highest-term leader wins; a stale
+    # leader simply stays silent until it learns the higher term) ----
+    lead_term = jnp.where(state.role == LEADER, state.term, -1)
+    lead = jnp.argmax(lead_term, axis=1).astype(jnp.int32)
+    active = jnp.take_along_axis(lead_term, lead[:, None], 1)[:, 0] >= 0
+    lead = jnp.where(active, lead, -1)
+
+    l_term = _peer_view(state.term, lead)          # [G]
+    l_last = _peer_view(state.last_index, lead)    # [G]
+    l_commit = _peer_view(state.commit_index, lead)
+    l_applied = _peer_view(state.applied_index, lead)
+    l_next = _peer_view(state.next_index, lead)    # [G,P]
+    l_match = _peer_view(state.match_index, lead)  # [G,P]
+    l_log_term = _peer_view(state.log_term, lead)  # [G,L]
+    l_log_op = _peer_view(state.log_op, lead)
+    l_log_a = _peer_view(state.log_a, lead)
+    l_log_b = _peer_view(state.log_b, lead)
+    l_log_tag = _peer_view(state.log_tag, lead)
+
+    # ---- phase 1: inject client submits into the leader log ----
+    # Backpressure: never let the ring overwrite entries the leader itself or
+    # a quorum-th replica still has to apply (laggards beyond the window go
+    # stale and are snapshot-installed by the host).
+    applied_sorted = jnp.sort(state.applied_index, axis=1)[:, ::-1]
+    q_applied = applied_sorted[:, quorum - 1]
+    allowed_last = jnp.minimum(l_applied, q_applied) + L
+
+    valid = submits.valid & active[:, None]
+    pos = l_last[:, None] + jnp.cumsum(valid.astype(jnp.int32), axis=1)
+    accepted = valid & (pos <= allowed_last[:, None])
+    for s in range(submits.valid.shape[1]):
+        slot = (pos[:, s] - 1) % L
+        m = accepted[:, s]
+        l_log_term = l_log_term.at[g_ids, slot].set(
+            jnp.where(m, l_term, l_log_term[g_ids, slot]))
+        l_log_op = l_log_op.at[g_ids, slot].set(
+            jnp.where(m, submits.opcode[:, s], l_log_op[g_ids, slot]))
+        l_log_a = l_log_a.at[g_ids, slot].set(
+            jnp.where(m, submits.a[:, s], l_log_a[g_ids, slot]))
+        l_log_b = l_log_b.at[g_ids, slot].set(
+            jnp.where(m, submits.b[:, s], l_log_b[g_ids, slot]))
+        l_log_tag = l_log_tag.at[g_ids, slot].set(
+            jnp.where(m, submits.tag[:, s], l_log_tag[g_ids, slot]))
+    l_last = l_last + accepted.sum(axis=1, dtype=jnp.int32)
+
+    # ---- phase 2: AppendEntries leader → followers ----
+    del_fwd = _peer_view(deliver, lead)                       # deliver[g,lead,f]
+    del_back = _peer_view(jnp.swapaxes(deliver, 1, 2), lead)  # deliver[g,f,lead]
+    recv = active[:, None] & (peer_ids[None, :] != lead[:, None]) & del_fwd
+
+    prev = l_next - 1                                         # [G,P]
+    # The leader can only serve entries still in its ring: prev must sit
+    # inside the window (prev == 0 qualifies only while the log hasn't
+    # wrapped — a wrapped leader must snapshot-install a fresh follower,
+    # never serve overwritten slots relabeled as old indices).
+    can_serve = prev > l_last[:, None] - L
+    stale = recv & ~can_serve
+    recv = recv & can_serve
+    prev_term = _term_at_2d(l_log_term, l_last, prev)
+    upto = jnp.minimum(prev + E, l_last[:, None])
+
+    msg_term = l_term[:, None]
+    ok_term = recv & (msg_term >= state.term)
+    reject_term = recv & (msg_term < state.term)
+
+    term1 = jnp.where(ok_term, msg_term, state.term)
+    voted1 = jnp.where(ok_term & (msg_term > state.term), -1, state.voted_for)
+    role1 = jnp.where(ok_term, FOLLOWER, state.role)
+    hint1 = jnp.where(ok_term, lead[:, None], state.leader_hint)
+    heartbeat = ok_term
+
+    f_prev_term = _term_at_own(state.log_term, state.last_index, prev)
+    in_window = prev > state.last_index - L
+    match = ok_term & (
+        (prev == 0)
+        | (prev <= state.commit_index)  # committed prefix always matches
+        | ((prev <= state.last_index) & in_window & (f_prev_term == prev_term)))
+
+    log_term2, log_op2 = state.log_term, state.log_op
+    log_a2, log_b2, log_tag2 = state.log_a, state.log_b, state.log_tag
+    for e in range(E):
+        idx = prev + 1 + e
+        send = match & (idx <= upto)
+        slot_l = (idx - 1) % L
+        ent_term = jnp.take_along_axis(l_log_term, slot_l, axis=1)
+        ent_op = jnp.take_along_axis(l_log_op, slot_l, axis=1)
+        ent_a = jnp.take_along_axis(l_log_a, slot_l, axis=1)
+        ent_b = jnp.take_along_axis(l_log_b, slot_l, axis=1)
+        ent_tag = jnp.take_along_axis(l_log_tag, slot_l, axis=1)
+        slot_f = slot_l  # same absolute index → same ring slot
+        log_term2 = _slot_write(log_term2, slot_f, send, ent_term)
+        log_op2 = _slot_write(log_op2, slot_f, send, ent_op)
+        log_a2 = _slot_write(log_a2, slot_f, send, ent_a)
+        log_b2 = _slot_write(log_b2, slot_f, send, ent_b)
+        log_tag2 = _slot_write(log_tag2, slot_f, send, ent_tag)
+
+    entries_sent = match & (upto >= prev + 1)
+    last2 = jnp.where(entries_sent, upto, state.last_index)
+    # Commit advance only after the consistency check passed, capped at the
+    # last VERIFIED entry (prev + entries appended) — a follower's unverified
+    # tail must never be committed by a leaderCommit heartbeat (Raft §5.3).
+    verified = jnp.where(entries_sent, upto, prev)
+    commit2 = jnp.where(
+        match,
+        jnp.maximum(state.commit_index,
+                    jnp.minimum(l_commit[:, None], verified)),
+        state.commit_index)
+
+    # ---- phase 3: acks → matchIndex/nextIndex, quorum commit advance ----
+    ack_seen = (recv | reject_term) & del_back
+    leader_stale = active & jnp.any(ack_seen & (term1 > l_term[:, None]), axis=1)
+    max_ack_term = jnp.max(jnp.where(ack_seen, term1, 0), axis=1)
+
+    ack_success = match & del_back
+    ack_match = jnp.where(entries_sent, upto, prev)
+    l_match = jnp.where(ack_success, jnp.maximum(l_match, ack_match), l_match)
+    l_next = jnp.where(ack_success, l_match + 1, l_next)
+    ack_fail = ok_term & ~match & del_back
+    hint = jnp.where(prev <= state.last_index, prev - 1, state.last_index)
+    l_next = jnp.where(ack_fail,
+                       jnp.clip(jnp.minimum(prev, hint + 1), 1, None), l_next)
+
+    self_lane = peer_ids[None, :] == lead[:, None]
+    match_full = jnp.where(self_lane, l_last[:, None], l_match)
+    cand_commit = jnp.sort(match_full, axis=1)[:, ::-1][:, quorum - 1]
+    cand_commit_term = _term_at_2d(l_log_term, l_last, cand_commit[:, None])[:, 0]
+    advance = active & ~leader_stale & (cand_commit > l_commit) \
+        & (cand_commit_term == l_term)
+    l_commit = jnp.where(advance, cand_commit, l_commit)
+
+    # Scatter the leader view back into replica lanes.
+    sc = ~leader_stale & active
+    term1 = jnp.where(self_lane & leader_stale[:, None],
+                      jnp.maximum(l_term[:, None], max_ack_term[:, None]), term1)
+    role1 = jnp.where(self_lane & leader_stale[:, None], FOLLOWER, role1)
+    voted1 = jnp.where(self_lane & leader_stale[:, None], -1, voted1)
+    last2 = _scatter_lane(last2, lead, active, l_last)
+    commit2 = _scatter_lane(commit2, lead, sc, l_commit)
+    next2 = _scatter_lane(state.next_index, lead, sc, l_next)
+    match2 = _scatter_lane(state.match_index, lead, sc, l_match)
+    log_term2 = _scatter_lane(log_term2, lead, active, l_log_term)
+    log_op2 = _scatter_lane(log_op2, lead, active, l_log_op)
+    log_a2 = _scatter_lane(log_a2, lead, active, l_log_a)
+    log_b2 = _scatter_lane(log_b2, lead, active, l_log_b)
+    log_tag2 = _scatter_lane(log_tag2, lead, active, l_log_tag)
+
+    # ---- phase 4: election timers + RequestVote tally ----
+    key_t, key_c = jax.random.split(key)
+    fresh = jax.random.randint(key_t, (G, P), config.timer_min, config.timer_max)
+    is_ldr = role1 == LEADER
+    timer1 = jnp.where(heartbeat | is_ldr, fresh, state.timer - 1)
+    timeout = ~is_ldr & ~heartbeat & (timer1 <= 0)
+
+    term_e = jnp.where(timeout, term1 + 1, term1)
+    voted_e = jnp.where(timeout, peer_ids[None, :], voted1)
+    role_e = jnp.where(timeout, CANDIDATE, role1)
+    timer1 = jnp.where(
+        timeout, jax.random.randint(key_c, (G, P), config.timer_min,
+                                    config.timer_max), timer1)
+
+    cand_mask = role_e == CANDIDATE
+    # A vote needs request AND response delivery.
+    reach = cand_mask[:, :, None] & deliver & jnp.swapaxes(deliver, 1, 2)
+    c_term_b = jnp.where(reach, term_e[:, :, None], 0)
+    v_seen = c_term_b.max(axis=1)                                 # [G,V]
+    higher = v_seen > term_e
+    term_v = jnp.maximum(term_e, v_seen)
+    voted_v = jnp.where(higher, -1, voted_e)
+    role_v = jnp.where(higher, FOLLOWER, role_e)
+
+    own_last_term = _term_at_own(log_term2, last2, last2)         # [G,P]
+    c_pair = (own_last_term[:, :, None], last2[:, :, None])
+    v_pair = (own_last_term[:, None, :], last2[:, None, :])
+    up_to_date = (c_pair[0] > v_pair[0]) | (
+        (c_pair[0] == v_pair[0]) & (c_pair[1] >= v_pair[1]))
+
+    elig = reach & (term_e[:, :, None] == term_v[:, None, :]) & up_to_date \
+        & ((voted_v[:, None, :] == -1) | (voted_v[:, None, :] == peer_ids[None, :, None]))
+    choice = jnp.where(elig, peer_ids[None, :, None], P).min(axis=1)  # [G,V]
+    voted_v = jnp.where(choice < P, choice, voted_v)
+    grant = elig & (peer_ids[None, :, None] == choice[:, None, :])
+    votes = grant.sum(axis=2)                                     # [G,C]
+    # role_v is the post-vote role on the candidate's own lane (it may have
+    # stepped down to a higher-term candidate).
+    won = (role_v == CANDIDATE) & cand_mask & (votes >= quorum)
+
+    role_f = jnp.where(won, LEADER, role_v)
+    hint_f = jnp.where(won, peer_ids[None, :], hint1)
+    # Winner initializes nextIndex/matchIndex and appends a NoOp of its term.
+    win_lane = won[:, :, None]
+    next2 = jnp.where(win_lane, last2[:, :, None] + 2, next2)  # +1 entry +NoOp
+    match2 = jnp.where(win_lane, 0, match2)
+    noop_idx = last2 + 1
+    noop_slot = (noop_idx - 1) % L
+    log_term2 = _slot_write(log_term2, noop_slot, won, term_v)
+    log_op2 = _slot_write(log_op2, noop_slot, won, jnp.zeros_like(term_v))
+    log_tag2 = _slot_write(log_tag2, noop_slot, won, jnp.zeros_like(term_v))
+    last_f = jnp.where(won, noop_idx, last2)
+
+    # ---- phase 5: apply committed entries (all replicas, A per round) ----
+    resources = state.resources
+    applied = state.applied_index
+    out_valid = jnp.zeros((G, A), bool)
+    out_tag = jnp.zeros((G, A), jnp.int32)
+    out_result = jnp.zeros((G, A), jnp.int32)
+    for i in range(A):
+        idx = applied + 1
+        do = idx <= commit2
+        slot = ((idx - 1) % L)[..., None]
+        op_i = jnp.take_along_axis(log_op2, slot, axis=2).squeeze(-1)
+        a_i = jnp.take_along_axis(log_a2, slot, axis=2).squeeze(-1)
+        b_i = jnp.take_along_axis(log_b2, slot, axis=2).squeeze(-1)
+        tag_i = jnp.take_along_axis(log_tag2, slot, axis=2).squeeze(-1)
+        resources, result = apply_entry(resources, op_i, a_i, b_i, do)
+        applied = jnp.where(do, idx, applied)
+        lead_do = _peer_view(do, lead) & active
+        out_valid = out_valid.at[:, i].set(lead_do)
+        out_tag = out_tag.at[:, i].set(
+            jnp.where(lead_do, _peer_view(tag_i, lead), 0))
+        out_result = out_result.at[:, i].set(
+            jnp.where(lead_do, _peer_view(result, lead), 0))
+
+    new_state = RaftState(
+        term=jnp.maximum(term_v, term_e), voted_for=voted_v, role=role_f,
+        leader_hint=hint_f, timer=timer1,
+        last_index=last_f, commit_index=commit2, applied_index=applied,
+        next_index=next2, match_index=match2,
+        log_term=log_term2, log_op=log_op2, log_a=log_a2, log_b=log_b2,
+        log_tag=log_tag2, resources=resources)
+    outputs = StepOutputs(
+        accepted=accepted, out_valid=out_valid, out_tag=out_tag,
+        out_result=out_result, leader=lead,
+        commit_index=jnp.where(active, l_commit, jnp.max(commit2, axis=1)),
+        stale=stale)
+    return new_state, outputs

@@ -1,0 +1,67 @@
+"""Mesh construction and sharding specs for ``RaftState``.
+
+The step function in ``ops.consensus`` is written as pure array ops over
+``[G, P, ...]`` tensors; sharding is applied by *placement only* —
+``jax.device_put`` with ``NamedSharding`` on the inputs — and XLA inserts
+the ICI collectives (all-gathers for the ``[G,P,P]`` vote/ack contractions,
+reductions for quorum tallies) from the annotations. No hand-written
+collectives: the compiler owns the schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.consensus import RaftState, Submits
+
+
+def make_mesh(groups: int | None = None, peers: int | None = None,
+              devices: list | None = None) -> Mesh:
+    """Build a 1D ``('groups',)`` or 2D ``('groups','peers')`` mesh."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if peers is None:
+        groups = groups or n
+        return Mesh(np.asarray(devices[:groups]), ("groups",))
+    groups = groups or n // peers
+    if groups * peers > n:
+        raise ValueError(f"mesh {groups}x{peers} needs {groups * peers} devices, have {n}")
+    dev = np.asarray(devices[: groups * peers]).reshape(groups, peers)
+    return Mesh(dev, ("groups", "peers"))
+
+
+def raft_specs(mesh: Mesh) -> RaftState:
+    """Per-field PartitionSpecs: group axis sharded, peer axis sharded when
+    the mesh has a ``peers`` axis, log/ring axes replicated."""
+    g = "groups" if "groups" in mesh.axis_names else None
+    p = "peers" if "peers" in mesh.axis_names else None
+    s2 = P(g, p)        # [G,P]
+    s3 = P(g, p, None)  # [G,P,P] (owner axis sharded) and [G,P,L]
+    from ..ops.apply import ResourceState
+    return RaftState(
+        term=s2, voted_for=s2, role=s2, leader_hint=s2, timer=s2,
+        last_index=s2, commit_index=s2, applied_index=s2,
+        next_index=s3, match_index=s3,
+        log_term=s3, log_op=s3, log_a=s3, log_b=s3, log_tag=s3,
+        resources=ResourceState(value=s2),
+    )
+
+
+def shard_state(state: RaftState, mesh: Mesh) -> RaftState:
+    specs = raft_specs(mesh)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs)
+
+
+def shard_step_inputs(submits: Submits, deliver: Any, mesh: Mesh
+                      ) -> tuple[Submits, Any]:
+    g = "groups" if "groups" in mesh.axis_names else None
+    p = "peers" if "peers" in mesh.axis_names else None
+    sub = jax.tree.map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P(g, None))), submits)
+    dl = jax.device_put(deliver, NamedSharding(mesh, P(g, p, None)))
+    return sub, dl

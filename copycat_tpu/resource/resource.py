@@ -79,19 +79,38 @@ class AbstractResource(Resource):
         (reference ``DistributedAtomicValue.onChange`` et al.): the first local
         listener submits ``listen_op`` server-side; closing the last one
         submits the unlisten op in the background."""
+        import asyncio
+
         from ..utils.tasks import spawn
 
-        if not state.get("listening"):
-            state["listening"] = True
-            await self.submit(listen_op)
+        # Serialize listen/unlisten transitions: without the lock a failed
+        # Listen would leave ``listening`` wedged True, and a background
+        # Unlisten could race a new Listen submitted right after last-close.
+        gate: asyncio.Lock = state.setdefault("gate", asyncio.Lock())
+        # Register the local callback BEFORE submitting Listen: with ATOMIC
+        # consistency the first event can arrive before the Listen response
+        # (events-before-response, reference Consistency.java:157-176).
         listener = listeners.add(callback)
+        try:
+            async with gate:
+                if not state.get("listening"):
+                    await self.submit(listen_op)  # flag flips only on success
+                    state["listening"] = True
+        except BaseException:
+            listener.close()  # roll back so a retry re-submits
+            raise
         original_close = listener.close
+
+        async def unlisten_if_idle() -> None:
+            async with gate:
+                if len(listeners) == 0 and state.get("listening"):
+                    await self.submit(unlisten_op_factory())
+                    state["listening"] = False
 
         def close_and_maybe_unlisten() -> None:
             original_close()
             if len(listeners) == 0 and state.get("listening"):
-                state["listening"] = False
-                spawn(self.submit(unlisten_op_factory()), name="resource-unlisten")
+                spawn(unlisten_if_idle(), name="resource-unlisten")
 
         listener.close = close_and_maybe_unlisten  # type: ignore[method-assign]
         return listener

@@ -7,9 +7,19 @@ hardware) — flags must be set before the first ``import jax`` anywhere.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell may pre-set a TPU platform
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:
+    import jax
+
+    # Site customization (e.g. a TPU plugin) may pin jax_platforms via
+    # jax.config, which overrides the env var — override it back before any
+    # backend initializes so tests run on the virtual 8-device CPU mesh.
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pragma: no cover - jax is part of the baked image
+    pass
