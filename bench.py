@@ -1,0 +1,117 @@
+"""Headline benchmark: committed linearizable ops/sec over batched Raft groups.
+
+BASELINE.md metric: "committed ops/sec over 10k Raft groups". The reference
+publishes no numbers (BASELINE.md §published — absence verified), so
+``vs_baseline`` is reported against the BASELINE.json north-star target of
+1M linearizable ops/sec.
+
+Prints ONE JSON line on stdout; all diagnostics go to stderr.
+
+Shape of the run: G groups × 3 peers live on device; leaders are elected,
+then R rounds of the jitted consensus step run under ``lax.scan`` with every
+submit slot full (DistributedLong.addAndGet ops). Each committed entry is a
+quorum-replicated, leader-applied linearizable command; the count is summed
+on device and divided by wall time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from copycat_tpu.ops.apply import OP_LONG_ADD
+from copycat_tpu.ops.consensus import (
+    Config,
+    Submits,
+    full_delivery,
+    init_state,
+    step,
+)
+
+GROUPS = int(os.environ.get("COPYCAT_BENCH_GROUPS", "10000"))
+PEERS = int(os.environ.get("COPYCAT_BENCH_PEERS", "3"))
+LOG_SLOTS = int(os.environ.get("COPYCAT_BENCH_LOG_SLOTS", "32"))
+ROUNDS = int(os.environ.get("COPYCAT_BENCH_ROUNDS", "200"))
+REPEATS = int(os.environ.get("COPYCAT_BENCH_REPEATS", "3"))
+SUBMIT_SLOTS = 4
+NORTH_STAR_OPS = 1_000_000.0
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    config = Config()
+    key = jax.random.PRNGKey(0)
+    key, init_key = jax.random.split(key)
+    state = init_state(GROUPS, PEERS, LOG_SLOTS, init_key, config)
+    deliver = full_delivery(GROUPS, PEERS)
+
+    ones = jnp.ones((GROUPS, SUBMIT_SLOTS), jnp.int32)
+    submits = Submits(opcode=ones * OP_LONG_ADD, a=ones, b=ones * 0,
+                      tag=ones, valid=ones.astype(bool))
+    jit_step = jax.jit(partial(step, config=config))
+
+    log(f"bench: G={GROUPS} P={PEERS} L={LOG_SLOTS} rounds={ROUNDS} "
+        f"device={jax.devices()[0].platform}")
+
+    # Elect leaders in every group (empty submits).
+    empty = Submits(opcode=ones * 0, a=ones * 0, b=ones * 0, tag=ones * 0,
+                    valid=jnp.zeros((GROUPS, SUBMIT_SLOTS), bool))
+    t0 = time.perf_counter()
+    for r in range(100):
+        key, k = jax.random.split(key)
+        state, out = jit_step(state, empty, deliver, k)
+        if int((np.asarray(out.leader) >= 0).sum()) == GROUPS:
+            break
+    else:
+        raise RuntimeError("not all groups elected a leader")
+    log(f"bench: all {GROUPS} leaders elected in {r + 1} rounds "
+        f"({time.perf_counter() - t0:.1f}s incl. compile)")
+
+    def run(state, key):
+        def body(carry, _):
+            state, key = carry
+            key, k = jax.random.split(key)
+            state, out = step(state, submits, deliver, k, config=config)
+            return (state, key), out.out_valid.sum(dtype=jnp.int32)
+        (state, key), counts = jax.lax.scan(body, (state, key), None,
+                                            length=ROUNDS)
+        return state, key, counts.sum()
+
+    run_jit = jax.jit(run)
+
+    # Warmup (compile + reach steady state).
+    state, key, n = run_jit(state, key)
+    jax.block_until_ready(n)
+    log(f"bench: warmup committed {int(n)} ops")
+
+    best = 0.0
+    for rep in range(REPEATS):
+        t0 = time.perf_counter()
+        state, key, n = run_jit(state, key)
+        n = int(jax.block_until_ready(n))
+        dt = time.perf_counter() - t0
+        ops = n / dt
+        best = max(best, ops)
+        log(f"bench: rep {rep}: {n} committed ops in {dt:.3f}s -> "
+            f"{ops:,.0f} ops/sec ({dt / ROUNDS * 1e3:.2f} ms/round)")
+
+    print(json.dumps({
+        "metric": f"committed_linearizable_ops_per_sec_{GROUPS}_groups",
+        "value": round(best, 1),
+        "unit": "ops/sec",
+        "vs_baseline": round(best / NORTH_STAR_OPS, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
