@@ -6,6 +6,7 @@ as a fencing token validated with ``is_leader(epoch)``."""
 
 from __future__ import annotations
 
+import asyncio
 from typing import Any, Callable
 
 from ..resource.resource import AbstractResource, resource_info
@@ -20,6 +21,10 @@ class DistributedLeaderElection(AbstractResource):
         super().__init__(client)
         self._listeners = Listeners()
         self._listening = False
+        # Serializes Listen/Unlisten transitions: without it, an on_election
+        # racing a resign() sees _listening still True mid-Unlisten and never
+        # re-submits Listen (same gate as AbstractResource._tracked_listener).
+        self._gate = asyncio.Lock()
         self.session().on_event("elect", self._on_elect)
 
     def _on_elect(self, epoch: int) -> None:
@@ -32,22 +37,22 @@ class DistributedLeaderElection(AbstractResource):
         # consistency the "elect" event reaches us before the Listen response
         # (events-before-response, reference Consistency.java:157-176).
         listener = self._listeners.add(callback)
-        if not self._listening:
-            self._listening = True
-            try:
-                await self.submit(c.ElectionListen())
-            except BaseException:
-                # Roll back so a retry re-submits instead of wedging.
-                self._listening = False
-                listener.close()
-                raise
+        try:
+            async with self._gate:
+                if not self._listening:
+                    await self.submit(c.ElectionListen())
+                    self._listening = True  # flips only on success
+        except BaseException:
+            listener.close()  # roll back so a retry re-submits
+            raise
         return listener
 
     async def resign(self) -> None:
         """Give up leadership / candidacy (submits Unlisten)."""
-        if self._listening:
-            await self.submit(c.ElectionUnlisten())
-            self._listening = False
+        async with self._gate:
+            if self._listening:
+                await self.submit(c.ElectionUnlisten())
+                self._listening = False
 
     async def is_leader(self, epoch: int) -> bool:
         """Validate a fencing token against current leadership."""

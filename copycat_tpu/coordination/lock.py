@@ -11,6 +11,7 @@ import asyncio
 from typing import Any
 
 from ..resource.resource import AbstractResource, resource_info
+from ..utils.tasks import spawn
 from . import commands as c
 from .state import LockState
 
@@ -23,24 +24,51 @@ class DistributedLock(AbstractResource):
         # Grants can arrive BEFORE the submit response that tells us our id
         # (events-before-response for LINEARIZABLE commands): buffer them.
         self._early_events: dict[int, bool] = {}
+        # Submits that failed after the server may have committed them: their
+        # grant (if any) will arrive under an id we never learned.
+        self._orphaned = 0
+        self._inflight = 0
         self.session().on_event("lock", self._on_lock_event)
 
     def _on_lock_event(self, event: dict) -> None:
         waiter_id, acquired = int(event["id"]), bool(event["acquired"])
         fut = self._waiters.pop(waiter_id, None)
-        if fut is not None:
-            if not fut.done():
-                fut.set_result(acquired)
-        else:
+        if fut is None:
             self._early_events[waiter_id] = acquired
+            self._reap_orphans()
+        elif not fut.done():
+            fut.set_result(acquired)
+        elif acquired:
+            # Grant landed on an abandoned waiter (lock() task cancelled while
+            # awaiting): release immediately so other clients can proceed.
+            spawn(self.submit(c.Unlock()))
+
+    def _reap_orphans(self) -> None:
+        """Discard buffered events belonging to failed submits (releasing any
+        grant among them). Only safe when no submit is in flight — then every
+        buffered event is provably unclaimable."""
+        while self._orphaned and not self._inflight and self._early_events:
+            _, acquired = self._early_events.popitem()
+            self._orphaned -= 1
+            if acquired:
+                spawn(self.submit(c.Unlock()))
 
     async def _submit_lock(self, timeout: float) -> asyncio.Future:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        waiter_id = int(await self.submit(c.Lock(timeout=timeout)))
+        self._inflight += 1
+        try:
+            waiter_id = int(await self.submit(c.Lock(timeout=timeout)))
+        except BaseException:
+            self._inflight -= 1
+            self._orphaned += 1
+            self._reap_orphans()
+            raise
+        self._inflight -= 1
         if waiter_id in self._early_events:
             fut.set_result(self._early_events.pop(waiter_id))
         else:
             self._waiters[waiter_id] = fut
+        self._reap_orphans()
         return fut
 
     async def lock(self) -> None:

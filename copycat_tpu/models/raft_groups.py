@@ -23,7 +23,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops import apply as apply_ops
 from ..ops.consensus import (
     Config,
     RaftState,
@@ -31,6 +30,7 @@ from ..ops.consensus import (
     Submits,
     full_delivery,
     init_state,
+    install_snapshots,
     step,
 )
 
@@ -67,6 +67,7 @@ class RaftGroups:
                 self._empty_submits(), self.deliver, mesh)
 
         self._step = jax.jit(partial(step, config=self.config))
+        self._install = jax.jit(partial(install_snapshots, config=self.config))
         self._queues: dict[int, deque] = {}
         self._next_tag = 1
         self._inflight: dict[int, int] = {}  # tag -> group
@@ -124,7 +125,12 @@ class RaftGroups:
         self.rounds += 1
         if not explicit:
             self._requeue_rejected(submits, out)
-            self._harvest(out)
+        self._harvest(out)
+        # Followers lagging beyond the ring window can't be served by
+        # AppendEntries: install a snapshot of the leader's lane (log ring +
+        # applied resource state) so they reconverge.
+        if bool(np.asarray(out.stale).any()):
+            self.state = self._install(self.state, out.stale, out.leader)
         return out
 
     def _requeue_rejected(self, submits: Submits, out: StepOutputs) -> None:

@@ -170,6 +170,44 @@ def _slot_write(log: jnp.ndarray, slot: jnp.ndarray, mask: jnp.ndarray,
     return jnp.where(hit, value[..., None], log)
 
 
+def install_snapshots(state: RaftState, stale: jnp.ndarray,
+                      leader: jnp.ndarray,
+                      config: Config = Config()) -> RaftState:
+    """Catch up followers flagged ``stale`` by copying the leader's lane.
+
+    A follower lagging beyond the ring window can never be served by
+    AppendEntries (``can_serve`` in :func:`step`); the reference would ship a
+    compacted log segment here. Since live state = applied state + the ring
+    (SURVEY.md §5.4), installing a snapshot is: copy the leader's log ring,
+    indices and resource state into the stale lane and re-follow the leader.
+    Vectorized over all flagged ``[G, P]`` lanes; jit-safe.
+    """
+    has = stale & (leader >= 0)[:, None]
+
+    def cp(x: jnp.ndarray) -> jnp.ndarray:
+        lv = _peer_view(x, leader)
+        mask = has.reshape(has.shape + (1,) * (x.ndim - 2))
+        return jnp.where(mask, jnp.expand_dims(lv, 1), x)
+
+    return state._replace(
+        term=cp(state.term),
+        voted_for=jnp.where(has, leader[:, None], state.voted_for),
+        role=jnp.where(has, FOLLOWER, state.role),
+        leader_hint=jnp.where(has, leader[:, None], state.leader_hint),
+        # Fresh full timeout so the caught-up follower doesn't immediately
+        # depose the leader it just synced from.
+        timer=jnp.where(has, config.timer_max, state.timer),
+        last_index=cp(state.last_index), commit_index=cp(state.commit_index),
+        applied_index=cp(state.applied_index),
+        # next/match are as-owner state: unused until this lane wins an
+        # election, which reinitializes them — leave untouched.
+        log_term=cp(state.log_term), log_op=cp(state.log_op),
+        log_a=cp(state.log_a), log_b=cp(state.log_b),
+        log_tag=cp(state.log_tag),
+        resources=jax.tree.map(cp, state.resources),
+    )
+
+
 # ---------------------------------------------------------------------------
 # the step
 # ---------------------------------------------------------------------------

@@ -172,6 +172,37 @@ def test_safety_under_random_partitions():
             assert len(tags) <= 1, (g, idx, tags)
 
 
+def test_stale_follower_caught_up_by_snapshot_install():
+    """A follower partitioned past the ring window reconverges via
+    host-side snapshot install (``install_snapshots``)."""
+    L = 8
+    rg = make(groups=1, peers=3, log_slots=L)
+    rg.wait_for_leaders()
+    leader = rg.leader(0)
+    follower = next(p for p in range(3) if p != leader)
+
+    # Fully isolate one follower; quorum of 2 keeps committing far past L.
+    deliver = np.ones((1, 3, 3), bool)
+    deliver[0, :, follower] = False
+    deliver[0, follower, :] = False
+    rg.deliver = jnp.asarray(deliver)
+    tags = []
+    for i in range(3 * L):
+        tags.append(rg.submit(0, ap.OP_LONG_ADD, 1))
+        rg.step_round()
+    rg.run_until(tags, max_rounds=200)
+    assert int(np.asarray(rg.state.commit_index)[0, leader]) > L
+
+    # Heal: AppendEntries can no longer serve the follower (beyond the ring);
+    # the stale flag must trigger snapshot install and full reconvergence.
+    rg.deliver = jnp.ones((1, 3, 3), bool)
+    rg.run(30)
+    val = np.asarray(rg.state.resources.value)
+    applied = np.asarray(rg.state.applied_index)
+    assert (val[0] == 3 * L).all(), (val[0], applied[0])
+    assert len(set(applied[0].tolist())) == 1
+
+
 def test_single_peer_group_commits_immediately():
     rg = make(groups=1, peers=1)
     rg.wait_for_leaders()
