@@ -6,3 +6,13 @@ one-``ResourceManager``-per-server design, ``AtomixReplica.java:374``).
 """
 
 from .raft_groups import RaftGroups  # noqa: F401
+from .device_resources import (  # noqa: F401
+    DeviceElection,
+    DeviceLock,
+    DeviceLong,
+    DeviceMap,
+    DeviceQueue,
+    DeviceResourceError,
+    DeviceSet,
+    DeviceValue,
+)

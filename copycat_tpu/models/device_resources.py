@@ -1,0 +1,331 @@
+"""Typed client facades over the device consensus path.
+
+The reference's client-side resource classes (``DistributedAtomicValue.java:38``,
+``DistributedAtomicLong.java:29``, ``DistributedMap.java:54``,
+``DistributedSet.java:35``, ``DistributedQueue.java:34``,
+``DistributedLock.java:58``, ``DistributedLeaderElection.java:66``) wrap a
+session client and submit operation objects. Here each facade binds one
+*group* of a :class:`~copycat_tpu.models.raft_groups.RaftGroups` batch and
+submits device opcodes; every call is a quorum-committed, linearizable
+command applied by the vectorized kernels (``ops/apply.py``).
+
+Lock grants and election notifications are delivered as *events* (the
+reference pushes session events, ``LockState.java publish("lock", …)``);
+facades consume the group's event stream with a private cursor.
+
+Synchronous by design: each call drives the batch loop until its tag
+resolves. Batch-parallel use (the bench path) submits raw opcodes across
+many groups instead.
+"""
+
+from __future__ import annotations
+
+from . import raft_groups
+from ..ops import apply as ops
+
+FAIL = ops.FAIL
+
+
+class DeviceResourceError(RuntimeError):
+    """Fixed-capacity device pool overflowed (fall back to the CPU path)."""
+
+
+def _check_value(v: int) -> int:
+    """Device-path payloads must avoid the INT_MIN sentinel (apply.py)."""
+    if v == FAIL:
+        raise ValueError(
+            "INT_MIN is reserved as the device-path FAIL sentinel")
+    return v
+
+
+class DeviceResource:
+    """Base: one facade = one group of the batch."""
+
+    def __init__(self, groups: "raft_groups.RaftGroups", group: int) -> None:
+        self._rg = groups
+        self._group = group
+        self._ev_last = -1  # absolute event seq already consumed
+
+    def _call(self, opcode: int, a: int = 0, b: int = 0, c: int = 0) -> int:
+        tag = self._rg.submit(self._group, opcode, a, b, c)
+        self._rg.run_until([tag])
+        return self._rg.results.pop(tag)  # facade path stays bounded
+
+    def _checked(self, *args) -> int:
+        result = self._call(*args)
+        if result == FAIL:
+            raise DeviceResourceError(
+                f"device pool overflow/absent for op {args[0]} in group "
+                f"{self._group}")
+        return result
+
+    def _events(self):
+        """Yield this group's events newer than the facade's cursor."""
+        for ev in self._rg.events.get(self._group, []):
+            if ev[0] > self._ev_last:
+                self._ev_last = ev[0]
+                yield ev
+
+
+class DeviceValue(DeviceResource):
+    """Linearizable int32 register (DistributedAtomicValue.java:38)."""
+
+    def get(self) -> int:
+        return self._call(ops.OP_VALUE_GET)
+
+    def set(self, value: int, ttl: int = 0) -> None:
+        self._call(ops.OP_VALUE_SET, value, 0, ttl)
+
+    def compare_and_set(self, expect: int, update: int) -> bool:
+        return bool(self._call(ops.OP_VALUE_CAS, expect, update))
+
+    def get_and_set(self, value: int) -> int:
+        return self._call(ops.OP_VALUE_GET_AND_SET, value)
+
+
+class DeviceLong(DeviceResource):
+    """Counter (DistributedAtomicLong.java:29). Unlike the reference's
+    client-side CAS-retry loop, the add is a single committed command —
+    the apply kernel is already atomic in log order."""
+
+    def get(self) -> int:
+        return self._call(ops.OP_VALUE_GET)
+
+    def add_and_get(self, delta: int = 1) -> int:
+        return self._call(ops.OP_LONG_ADD, delta)
+
+    def get_and_add(self, delta: int = 1) -> int:
+        return self.add_and_get(delta) - delta
+
+    def increment_and_get(self) -> int:
+        return self.add_and_get(1)
+
+    def decrement_and_get(self) -> int:
+        return self.add_and_get(-1)
+
+
+class DeviceMap(DeviceResource):
+    """Fixed-keyspace int32→int32 map (DistributedMap.java:54)."""
+
+    def put(self, key: int, value: int, ttl: int = 0) -> int:
+        return self._checked(ops.OP_MAP_PUT, key, _check_value(value), ttl)
+
+    def get(self, key: int) -> int:
+        return self._call(ops.OP_MAP_GET, key)
+
+    def get_or_default(self, key: int, default: int) -> int:
+        return self._call(ops.OP_MAP_GET_OR_DEFAULT, key, default)
+
+    def put_if_absent(self, key: int, value: int, ttl: int = 0) -> bool:
+        return bool(self._checked(ops.OP_MAP_PUT_IF_ABSENT, key,
+                                  _check_value(value), ttl))
+
+    def remove(self, key: int) -> int:
+        return self._call(ops.OP_MAP_REMOVE, key)
+
+    def remove_if(self, key: int, value: int) -> bool:
+        return bool(self._call(ops.OP_MAP_REMOVE_IF, key, value))
+
+    def replace(self, key: int, value: int) -> int | None:
+        result = self._call(ops.OP_MAP_REPLACE, key, _check_value(value))
+        return None if result == FAIL else result
+
+    def replace_if(self, key: int, expect: int, update: int) -> bool:
+        return bool(self._call(ops.OP_MAP_REPLACE_IF, key, expect,
+                               _check_value(update)))
+
+    def contains_key(self, key: int) -> bool:
+        return bool(self._call(ops.OP_MAP_CONTAINS_KEY, key))
+
+    def contains_value(self, value: int) -> bool:
+        return bool(self._call(ops.OP_MAP_CONTAINS_VALUE, value))
+
+    def size(self) -> int:
+        return self._call(ops.OP_MAP_SIZE)
+
+    def is_empty(self) -> bool:
+        return bool(self._call(ops.OP_MAP_IS_EMPTY))
+
+    def clear(self) -> None:
+        self._call(ops.OP_MAP_CLEAR)
+
+
+class DeviceSet(DeviceResource):
+    """Fixed-capacity int32 set (DistributedSet.java:35)."""
+
+    def add(self, value: int, ttl: int = 0) -> bool:
+        return bool(self._checked(ops.OP_SET_ADD, _check_value(value), 0,
+                                  ttl))
+
+    def remove(self, value: int) -> bool:
+        return bool(self._call(ops.OP_SET_REMOVE, value))
+
+    def contains(self, value: int) -> bool:
+        return bool(self._call(ops.OP_SET_CONTAINS, value))
+
+    def size(self) -> int:
+        return self._call(ops.OP_SET_SIZE)
+
+    def is_empty(self) -> bool:
+        return self.size() == 0
+
+    def clear(self) -> None:
+        self._call(ops.OP_SET_CLEAR)
+
+
+class DeviceQueue(DeviceResource):
+    """FIFO int32 queue ring (DistributedQueue.java:34 device subset)."""
+
+    def offer(self, value: int) -> bool:
+        return bool(self._call(ops.OP_Q_OFFER, _check_value(value)))
+
+    def add(self, value: int) -> None:
+        if not self.offer(value):
+            raise DeviceResourceError("queue full")
+
+    def poll(self) -> int | None:
+        result = self._call(ops.OP_Q_POLL)
+        return None if result == FAIL else result
+
+    def peek(self) -> int | None:
+        result = self._call(ops.OP_Q_PEEK)
+        return None if result == FAIL else result
+
+    def size(self) -> int:
+        return self._call(ops.OP_Q_SIZE)
+
+    def is_empty(self) -> bool:
+        return self.size() == 0
+
+    def clear(self) -> None:
+        self._call(ops.OP_Q_CLEAR)
+
+
+class DeviceLock(DeviceResource):
+    """Distributed mutex; grant arrives as a session event
+    (DistributedLock.java:58 — completion via event, not command response).
+
+    ``holder_id`` identifies this client in the lock's wait queue (the
+    reference uses the client session id)."""
+
+    def __init__(self, groups, group, holder_id: int) -> None:
+        super().__init__(groups, group)
+        self.holder_id = holder_id
+        # grants won via the cancel race (cancel result 2): the grant event
+        # still arrives later and must not satisfy a future acquire attempt
+        self._swallow_grants = 0
+
+    def _next_grant(self) -> bool:
+        for _, code, target, _arg in self._events():
+            if code == ops.EV_LOCK_GRANT and target == self.holder_id:
+                if self._swallow_grants:
+                    self._swallow_grants -= 1
+                    continue
+                return True
+        return False
+
+    def _await_grant(self, deadline_clock: int | None,
+                     max_rounds: int = 500) -> bool:
+        for i in range(max_rounds):
+            if self._next_grant():
+                return True
+            if i % 20 == 19:
+                # authoritative fallback: the replicated holder register is
+                # ground truth even if the grant event was lost to outbox
+                # overflow; swallow the (possibly still in-flight) event
+                if self._call(ops.OP_LOCK_HOLDER) == self.holder_id:
+                    self._swallow_grants += 1
+                    return True
+            if deadline_clock is not None and self._rg.clock >= deadline_clock:
+                # Timeout observed: resolve the race through the log — the
+                # CANCEL commits in total order with any grant (2 = we won
+                # before the cancel applied; the lock is ours).
+                if self._call(ops.OP_LOCK_CANCEL, self.holder_id) == 2:
+                    self._swallow_grants += 1
+                    return True
+                return False
+            self._rg.step_round()
+        raise TimeoutError("no lock grant event")
+
+    def lock(self) -> None:
+        result = self._call(ops.OP_LOCK_ACQUIRE, self.holder_id, -1)
+        if result == 1:
+            return
+        if result == 0:  # wait queue full
+            raise DeviceResourceError("lock wait queue full")
+        granted = self._await_grant(None)
+        if not granted:  # unreachable for an untimed wait; fail loudly
+            raise DeviceResourceError("lock wait aborted without grant")
+
+    def try_lock(self, timeout: int = 0) -> bool:
+        """``timeout`` in logical clock ticks; 0 = immediate."""
+        result = self._call(
+            ops.OP_LOCK_ACQUIRE, self.holder_id, max(0, timeout))
+        if result == 1:
+            return True
+        if timeout <= 0 or result == 0:
+            return False
+        return self._await_grant(self._rg.clock + timeout)
+
+    def unlock(self) -> None:
+        self._call(ops.OP_LOCK_RELEASE, self.holder_id)
+
+
+class DeviceElection(DeviceResource):
+    """Leader election with epoch fencing tokens
+    (DistributedLeaderElection.java:66 — epoch = commit index of the
+    winning listen; ``is_leader(epoch)`` validates before fenced actions)."""
+
+    def __init__(self, groups, group, candidate_id: int) -> None:
+        super().__init__(groups, group)
+        self.candidate_id = candidate_id
+        self.epoch: int | None = None
+        # promotions won but resigned before ever being polled: the elect
+        # event is still in flight and must not satisfy a future listen
+        self._swallow_elect = 0
+
+    def listen(self) -> int | None:
+        """Enter the election; returns the epoch if elected immediately."""
+        result = self._checked(ops.OP_ELECT_LISTEN, self.candidate_id)
+        if result > 0:
+            self.epoch = result
+        return self.epoch
+
+    def poll_elected(self) -> int | None:
+        """Consume elect events; returns the epoch once this candidate wins."""
+        for _, code, target, arg in self._events():
+            if code == ops.EV_ELECT and target == self.candidate_id:
+                if self._swallow_elect:
+                    self._swallow_elect -= 1
+                    continue
+                self.epoch = arg
+        return self.epoch
+
+    def refresh(self) -> int | None:
+        """Authoritative leadership check through the log (survives event
+        loss): updates and returns ``epoch`` if this candidate leads now."""
+        if self._call(ops.OP_ELECT_LEADER) == self.candidate_id:
+            epoch = self._call(ops.OP_ELECT_GET_EPOCH)
+            # leader+epoch were two commands; re-verify the pair atomically
+            # through the fencing check before trusting it
+            if self._call(ops.OP_ELECT_IS_LEADER, self.candidate_id, epoch):
+                if self.epoch is None:
+                    self._swallow_elect += 1  # elect event may still arrive
+                self.epoch = epoch
+                return self.epoch
+        return None
+
+    def is_leader(self, epoch: int | None = None) -> bool:
+        epoch = self.epoch if epoch is None else epoch
+        if epoch is None:
+            return False
+        return bool(self._call(ops.OP_ELECT_IS_LEADER, self.candidate_id,
+                               epoch))
+
+    def resign(self) -> bool:
+        was_leader = bool(self._call(ops.OP_ELECT_RESIGN, self.candidate_id))
+        if was_leader and self.epoch is None:
+            # we were promoted but never consumed the elect event
+            self._swallow_elect += 1
+        self.epoch = None
+        return was_leader

@@ -38,6 +38,8 @@ from ..ops.consensus import (
 class RaftGroups:
     """G Raft groups × P peers, stepped as one compiled program."""
 
+    MAX_EVENTS_PER_GROUP = 4096
+
     def __init__(
         self,
         num_groups: int,
@@ -70,9 +72,17 @@ class RaftGroups:
         self._install = jax.jit(partial(install_snapshots, config=self.config))
         self._queues: dict[int, deque] = {}
         self._next_tag = 1
-        self._inflight: dict[int, int] = {}  # tag -> group
+        self._inflight: dict[int, tuple[int, int]] = {}  # tag -> (group, round)
         self.results: dict[int, int] = {}    # tag -> result
         self.rounds = 0
+        # first-class ops/sec + latency metrics (SURVEY.md §5.5)
+        from ..utils.metrics import MetricsRegistry
+        self.metrics = MetricsRegistry()
+        self.clock = 0                       # mirrors the device logical clock
+        # session events per group: list of (seq, code, target, arg);
+        # deduped by absolute seq (ring re-delivers across leader changes)
+        self.events: dict[int, list[tuple[int, int, int, int]]] = {}
+        self._ev_seen: dict[int, int] = {}   # group -> highest seq consumed
 
     # -- op submission ---------------------------------------------------
 
@@ -81,15 +91,18 @@ class RaftGroups:
         return Submits(opcode=np.zeros((G, S), np.int32),
                        a=np.zeros((G, S), np.int32),
                        b=np.zeros((G, S), np.int32),
+                       c=np.zeros((G, S), np.int32),
                        tag=np.zeros((G, S), np.int32),
                        valid=np.zeros((G, S), bool))
 
-    def submit(self, group: int, opcode: int, a: int = 0, b: int = 0) -> int:
+    def submit(self, group: int, opcode: int, a: int = 0, b: int = 0,
+               c: int = 0) -> int:
         """Queue one op; returns a correlation tag resolved in ``results``."""
         tag = self._next_tag
         self._next_tag += 1
-        self._queues.setdefault(group, deque()).append((opcode, a, b, tag))
-        self._inflight[tag] = group
+        self._queues.setdefault(group, deque()).append((opcode, a, b, c, tag))
+        self._inflight[tag] = (group, self.rounds)
+        self.metrics.counter("ops_submitted").inc()
         return tag
 
     def _build_submits(self) -> Submits:
@@ -100,10 +113,11 @@ class RaftGroups:
             for s in range(self.submit_slots):
                 if not q:
                     break
-                opcode, a, b, tag = q.popleft()
+                opcode, a, b, c, tag = q.popleft()
                 sub.opcode[g, s] = opcode
                 sub.a[g, s] = a
                 sub.b[g, s] = b
+                sub.c[g, s] = c
                 sub.tag[g, s] = tag
                 sub.valid[g, s] = True
             if not q:
@@ -119,10 +133,13 @@ class RaftGroups:
         if submits is None:
             submits = self._build_submits()
         self._key, key = jax.random.split(self._key)
-        self.state, out = self._step(
-            self.state, submits,
-            self.deliver if deliver is None else deliver, key)
+        with self.metrics.timer("step_wall_ms"):
+            self.state, out = self._step(
+                self.state, submits,
+                self.deliver if deliver is None else deliver, key)
+            out = jax.block_until_ready(out)  # time compute, not dispatch
         self.rounds += 1
+        self.metrics.counter("rounds").inc()
         if not explicit:
             self._requeue_rejected(submits, out)
         self._harvest(out)
@@ -143,19 +160,43 @@ class RaftGroups:
         for g, s in reversed(list(zip(*np.nonzero(rejected)))):
             self._queues.setdefault(int(g), deque()).appendleft(
                 (int(submits.opcode[g, s]), int(submits.a[g, s]),
-                 int(submits.b[g, s]), int(submits.tag[g, s])))
+                 int(submits.b[g, s]), int(submits.c[g, s]),
+                 int(submits.tag[g, s])))
 
     def _harvest(self, out: StepOutputs) -> None:
+        self.clock = int(np.asarray(out.clock).max(initial=self.clock))
         valid = np.asarray(out.out_valid)
-        if not valid.any():
-            return
-        tags = np.asarray(out.out_tag)
-        res = np.asarray(out.out_result)
-        for g, i in zip(*np.nonzero(valid)):
-            tag = int(tags[g, i])
-            if tag and tag in self._inflight:
-                del self._inflight[tag]
-                self.results[tag] = int(res[g, i])
+        if valid.any():
+            tags = np.asarray(out.out_tag)
+            res = np.asarray(out.out_result)
+            latency = self.metrics.histogram("commit_latency_rounds")
+            committed = self.metrics.counter("ops_committed")
+            for g, i in zip(*np.nonzero(valid)):
+                tag = int(tags[g, i])
+                if tag and tag in self._inflight:
+                    _, submit_round = self._inflight.pop(tag)
+                    self.results[tag] = int(res[g, i])
+                    committed.inc()
+                    latency.record(self.rounds - submit_round)
+        ev_valid = np.asarray(out.ev_valid)
+        if ev_valid.any():
+            seq = np.asarray(out.ev_seq)
+            code = np.asarray(out.ev_code)
+            target = np.asarray(out.ev_target)
+            arg = np.asarray(out.ev_arg)
+            for g, i in zip(*np.nonzero(ev_valid)):
+                g = int(g)
+                s = int(seq[g, i])
+                if s <= self._ev_seen.get(g, -1):
+                    continue  # re-delivered after a leader change
+                self._ev_seen[g] = s
+                evs = self.events.setdefault(g, [])
+                evs.append(
+                    (s, int(code[g, i]), int(target[g, i]), int(arg[g, i])))
+                # bounded buffer: facades track absolute seqs, so trimming
+                # old events never invalidates a consumer cursor
+                if len(evs) > self.MAX_EVENTS_PER_GROUP:
+                    del evs[: len(evs) - self.MAX_EVENTS_PER_GROUP]
 
     def run(self, rounds: int) -> None:
         for _ in range(rounds):

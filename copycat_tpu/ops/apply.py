@@ -1,15 +1,35 @@
-"""Vectorized state-machine apply kernels.
+"""Vectorized state-machine apply kernels for every device resource type.
 
 The reference applies one commit at a time through per-resource executors
-(``ResourceManager.operateResource``, ``ResourceManager.java:56``;
-``AtomicValueState.java:32``). Here the same op semantics are data — an
-opcode plus two int32 arguments — applied to ALL groups' replicas at once
-with ``jnp.where`` masking, so XLA vectorizes the apply across the
+(``ResourceManager.operateResource``, ``ResourceManager.java:56``; resource
+state machines ``AtomicValueState.java:32``, ``MapState.java:32``,
+``LockState.java:33``, ``LeaderElectionState.java:31``, ``QueueState.java:30``,
+``SetState.java:32``). Here the same op semantics are data — an opcode plus
+three int32 arguments — applied to ALL groups' replicas at once with
+``jnp.where`` masking, so XLA vectorizes the apply across the
 ``[num_groups, num_peers]`` batch instead of dispatching per commit.
+
+Design rules (SURVEY.md §7.3):
+
+- **Fixed shapes**: maps/sets are fixed-slot probe tables, queues and wait
+  lists are fixed-capacity rings. Overflow returns the ``FAIL`` sentinel —
+  the host falls back to the CPU oracle path for oversized resources.
+- **Deterministic time** (§7.3 #3): TTLs and lock timeouts are evaluated
+  lazily against the *entry's* logical timestamp (the leader's replicated
+  round clock at append), never wall clock — replica state stays a pure
+  function of the applied log prefix, so all replicas converge bit-exactly.
+  Client-observed timeouts are driven through the log (``OP_LOCK_CANCEL``),
+  which totally orders grant-vs-timeout races (the reference instead runs
+  replicated ``executor().schedule`` timers, ``ResourceStateMachineExecutor``).
+- **Events** (§7.3 #4): session-push events (lock grant
+  ``LockState.java:publish("lock",…)``, election ``publish("elect",…)``)
+  go into a per-lane replicated event ring with absolute sequence numbers;
+  the step drains the leader lane into ``StepOutputs`` and the host dedups
+  by sequence across leader changes (at-least-once across failover).
 
 Only fixed-width state lives on device. Arbitrary Python payloads take the
 CPU oracle path (``copycat_tpu.server``); the device path covers the hot,
-fixed-shape resource kernels (BASELINE.md configs).
+fixed-shape resource kernels (BASELINE.md configs #1-#5).
 """
 
 from __future__ import annotations
@@ -18,15 +38,86 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
+INT_MIN = jnp.iinfo(jnp.int32).min
+INT_MAX = jnp.iinfo(jnp.int32).max
+
+#: Sentinel returned for failed/absent/overflow results. Device-path values
+#: must avoid INT_MIN (the host facades enforce this).
+FAIL = int(INT_MIN)
+
 # --- opcodes (device-path operation catalog) -------------------------------
-# Mirrors the reference's serializer-id catalogs (AtomicValueCommands ids
-# 50-55 etc.) as a dense opcode space.
+# Mirrors the reference's serializer-id catalogs as a dense opcode space:
+# AtomicValueCommands ids 50-55, MapCommands ids 60-72, SetCommands 100-105,
+# QueueCommands 90-99, LockCommands 115-116, LeaderElectionCommands 110-112.
 OP_NOP = 0
-OP_VALUE_SET = 1
+
+# value / long (AtomicValueState.java:32, DistributedAtomicLong.java:29)
+OP_VALUE_SET = 1          # a=value, c=ttl ticks (0 = none)
 OP_VALUE_GET = 2
-OP_VALUE_CAS = 3          # a=expect, b=update -> result: 1 if swapped else 0
-OP_VALUE_GET_AND_SET = 4  # a=update -> result: previous value
-OP_LONG_ADD = 5           # a=delta -> result: new value (addAndGet)
+OP_VALUE_CAS = 3          # a=expect, b=update -> 1 if swapped else 0
+OP_VALUE_GET_AND_SET = 4  # a=update -> previous value
+OP_LONG_ADD = 5           # a=delta -> new value (addAndGet)
+
+# map (MapState.java:32; hashed fixed keyspace per SURVEY.md §7.1)
+OP_MAP_PUT = 10           # a=key, b=value, c=ttl -> previous value | 0
+OP_MAP_GET = 11           # a=key -> value | 0
+OP_MAP_REMOVE = 12        # a=key -> previous value | 0
+OP_MAP_PUT_IF_ABSENT = 13  # a=key, b=value, c=ttl -> 1 if put else 0
+OP_MAP_GET_OR_DEFAULT = 14  # a=key, b=default
+OP_MAP_REMOVE_IF = 15     # a=key, b=value -> 1 if removed
+OP_MAP_REPLACE = 16       # a=key, b=value -> previous | FAIL if absent
+OP_MAP_REPLACE_IF = 17    # a=key, b=expect, c=update -> 1 if replaced
+OP_MAP_CONTAINS_KEY = 18  # a=key -> 0/1
+OP_MAP_CONTAINS_VALUE = 19  # a=value -> 0/1
+OP_MAP_SIZE = 20
+OP_MAP_IS_EMPTY = 21
+OP_MAP_CLEAR = 22
+
+# set (SetState.java:32)
+OP_SET_ADD = 30           # a=value, c=ttl -> 1 if added else 0
+OP_SET_REMOVE = 31        # a=value -> 1 if removed
+OP_SET_CONTAINS = 32      # a=value -> 0/1
+OP_SET_SIZE = 33
+OP_SET_CLEAR = 34
+
+# queue (QueueState.java:30; device subset — remove(v)/contains take the
+# CPU path, SURVEY.md §2.1 QueueState row)
+OP_Q_OFFER = 40           # a=value -> 1 | 0 when full
+OP_Q_POLL = 41            # -> value | FAIL when empty
+OP_Q_PEEK = 42            # -> value | FAIL when empty
+OP_Q_SIZE = 43
+OP_Q_CLEAR = 44
+
+# lock (LockState.java:33; grant delivered as an event, DistributedLock.java:58)
+OP_LOCK_ACQUIRE = 50      # a=holder id, b=timeout ticks (-1 forever, 0 try)
+OP_LOCK_RELEASE = 51      # a=holder id -> 1 if released
+OP_LOCK_CANCEL = 52       # a=holder id -> 2 already-granted | 1 dequeued | 0 gone
+OP_LOCK_HOLDER = 53       # -> current holder id | -1 (authoritative grant
+#                           check — the facades' fallback if a grant event
+#                           is lost to outbox-ring overflow)
+
+# leader election (LeaderElectionState.java:31; epoch = entry log index)
+OP_ELECT_LISTEN = 60      # a=candidate id -> epoch if elected now else 0
+OP_ELECT_RESIGN = 61      # a=candidate id (resign / unlisten)
+OP_ELECT_IS_LEADER = 62   # a=candidate id, b=epoch -> 0/1 (fencing check)
+OP_ELECT_LEADER = 63      # -> current leader id | -1 (authoritative)
+OP_ELECT_GET_EPOCH = 64   # -> current epoch
+
+# --- event codes (session push, harvested from the leader lane) ------------
+EV_NONE = 0
+EV_LOCK_GRANT = 1   # target=holder id, arg=1
+EV_ELECT = 3        # target=new leader id, arg=epoch (fencing token)
+
+
+class ResourceConfig(NamedTuple):
+    """Fixed device pool sizes (hashable — part of the jit-static Config)."""
+
+    map_slots: int = 16
+    set_slots: int = 16
+    queue_slots: int = 16
+    wait_slots: int = 8       # lock wait queue
+    listener_slots: int = 8   # election listener queue
+    event_slots: int = 32     # session-event outbox ring
 
 
 class ResourceState(NamedTuple):
@@ -35,50 +126,482 @@ class ResourceState(NamedTuple):
     Every field is ``[num_groups, num_peers, ...]``: each replica applies the
     same committed ops in the same order, so replica states stay identical —
     exactly the reference's replicated-state-machine discipline, kept as a
-    batch dimension so divergence is *testable* (see tests).
+    batch dimension so divergence is *testable* (see tests). The event ring
+    (``ev_*``) is outbox infrastructure, not linearizable state: lanes drain
+    it in lockstep, so its heads may differ across replicas.
     """
 
-    value: jnp.ndarray  # [G, P] int32 — AtomicValue/AtomicLong register
+    # value register + TTL deadline (0 = none)
+    value: jnp.ndarray    # [G,P] i32
+    val_dl: jnp.ndarray   # [G,P] i32
+
+    # hashed map: fixed probe table
+    map_key: jnp.ndarray   # [G,P,K] i32
+    map_val: jnp.ndarray   # [G,P,K] i32
+    map_live: jnp.ndarray  # [G,P,K] bool
+    map_dl: jnp.ndarray    # [G,P,K] i32 (0 = no TTL)
+
+    # set: probe table without values
+    set_key: jnp.ndarray   # [G,P,Ks] i32
+    set_live: jnp.ndarray  # [G,P,Ks] bool
+    set_dl: jnp.ndarray    # [G,P,Ks] i32
+
+    # FIFO queue ring
+    q_val: jnp.ndarray     # [G,P,Q] i32
+    q_head: jnp.ndarray    # [G,P] i32 (absolute pops)
+    q_size: jnp.ndarray    # [G,P] i32
+
+    # lock: holder + wait-queue ring (id, deadline, live)
+    lk_holder: jnp.ndarray   # [G,P] i32, -1 = free
+    lk_wait_id: jnp.ndarray  # [G,P,W] i32
+    lk_wait_dl: jnp.ndarray  # [G,P,W] i32 (INT_MAX = wait forever)
+    lk_wait_live: jnp.ndarray  # [G,P,W] bool
+    lk_head: jnp.ndarray     # [G,P] i32
+    lk_size: jnp.ndarray     # [G,P] i32
+
+    # leader election: leader + listener ring + epoch fencing token
+    el_leader: jnp.ndarray   # [G,P] i32, -1 = none
+    el_epoch: jnp.ndarray    # [G,P] i32 (log index of the winning listen)
+    el_id: jnp.ndarray       # [G,P,Wl] i32
+    el_live: jnp.ndarray     # [G,P,Wl] bool
+    el_head: jnp.ndarray     # [G,P] i32
+    el_size: jnp.ndarray     # [G,P] i32
+
+    # session-event outbox ring (code/target/arg), absolute head/tail seqs
+    ev_code: jnp.ndarray    # [G,P,E] i32
+    ev_target: jnp.ndarray  # [G,P,E] i32
+    ev_arg: jnp.ndarray     # [G,P,E] i32
+    ev_head: jnp.ndarray    # [G,P] i32
+    ev_tail: jnp.ndarray    # [G,P] i32
 
 
-def init_resources(num_groups: int, num_peers: int) -> ResourceState:
+def init_resources(num_groups: int, num_peers: int,
+                   rc: ResourceConfig = ResourceConfig()) -> ResourceState:
+    G, P = num_groups, num_peers
+    z2 = jnp.zeros((G, P), jnp.int32)
+
+    def zi(n):
+        return jnp.zeros((G, P, n), jnp.int32)
+
+    def zb(n):
+        return jnp.zeros((G, P, n), bool)
+
     return ResourceState(
-        value=jnp.zeros((num_groups, num_peers), jnp.int32),
+        value=z2, val_dl=z2,
+        map_key=zi(rc.map_slots), map_val=zi(rc.map_slots),
+        map_live=zb(rc.map_slots), map_dl=zi(rc.map_slots),
+        set_key=zi(rc.set_slots), set_live=zb(rc.set_slots),
+        set_dl=zi(rc.set_slots),
+        q_val=zi(rc.queue_slots), q_head=z2, q_size=z2,
+        lk_holder=z2 - 1, lk_wait_id=zi(rc.wait_slots),
+        lk_wait_dl=zi(rc.wait_slots), lk_wait_live=zb(rc.wait_slots),
+        lk_head=z2, lk_size=z2,
+        el_leader=z2 - 1, el_epoch=z2, el_id=zi(rc.listener_slots),
+        el_live=zb(rc.listener_slots), el_head=z2, el_size=z2,
+        ev_code=zi(rc.event_slots), ev_target=zi(rc.event_slots),
+        ev_arg=zi(rc.event_slots), ev_head=z2, ev_tail=z2,
     )
 
 
+# ---------------------------------------------------------------------------
+# small vectorized helpers over [G,P,N] pools
+# ---------------------------------------------------------------------------
+
+def _gather3(arr: jnp.ndarray, slot: jnp.ndarray) -> jnp.ndarray:
+    """arr[G,P,N] gathered at slot[G,P] -> [G,P]."""
+    return jnp.take_along_axis(arr, slot[..., None], axis=2).squeeze(-1)
+
+
+def _scatter3(arr: jnp.ndarray, slot: jnp.ndarray, mask: jnp.ndarray,
+              value: jnp.ndarray) -> jnp.ndarray:
+    """Masked write of value[G,P] into arr[G,P,N] at slot[G,P]."""
+    N = arr.shape[-1]
+    hit = (jnp.arange(N)[None, None, :] == slot[..., None]) & mask[..., None]
+    return jnp.where(hit, value[..., None], arr)
+
+
+def _first_true(mask: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(index of first True along last axis, any True) for mask[G,P,N]."""
+    idx = jnp.argmax(mask, axis=-1).astype(jnp.int32)
+    return idx, jnp.any(mask, axis=-1)
+
+
+def _ring_pos(head: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Position-in-queue of each ring slot: [G,P,N] given head[G,P]."""
+    slots = jnp.arange(n, dtype=jnp.int32)[None, None, :]
+    return (slots - head[..., None]) % n
+
+
+# ---------------------------------------------------------------------------
+# the apply kernel
+# ---------------------------------------------------------------------------
+
 def apply_entry(
     res: ResourceState,
-    opcode: jnp.ndarray,  # [G, P] int32
-    a: jnp.ndarray,       # [G, P] int32
-    b: jnp.ndarray,       # [G, P] int32
-    live: jnp.ndarray,    # [G, P] bool — entry exists and is being applied
+    opcode: jnp.ndarray,  # [G,P] i32
+    a: jnp.ndarray,       # [G,P] i32
+    b: jnp.ndarray,       # [G,P] i32
+    c: jnp.ndarray,       # [G,P] i32
+    index: jnp.ndarray,   # [G,P] i32 — absolute log index of this entry
+    now: jnp.ndarray,     # [G,P] i32 — entry's logical timestamp
+    live: jnp.ndarray,    # [G,P] bool — entry exists and is being applied
 ) -> tuple[ResourceState, jnp.ndarray]:
     """Apply one committed entry per (group, replica) lane.
 
     Returns ``(new_state, result)`` where ``result`` is the int32 command
-    response for the lane (meaningful only where ``live``).
+    response for the lane (meaningful only where ``live``). Session events
+    are pushed into the state's event ring.
     """
-    value = res.value
+    # exactly one event per applied entry (grant/fail/elect are mutually
+    # exclusive across opcodes), accumulated and pushed once at the end
+    ev_mask = jnp.zeros_like(live)
+    ev_code = jnp.zeros_like(opcode)
+    ev_target = jnp.zeros_like(opcode)
+    ev_arg = jnp.zeros_like(opcode)
+    result = jnp.zeros_like(opcode)
+
+    # ---- value / long -----------------------------------------------------
+    value, val_dl = res.value, res.val_dl
+    expired = (val_dl > 0) & (val_dl <= now)
+    eff = jnp.where(expired, 0, value)  # TTL'd value reads as unset
 
     is_set = live & (opcode == OP_VALUE_SET)
     is_get = live & (opcode == OP_VALUE_GET)
     is_cas = live & (opcode == OP_VALUE_CAS)
     is_gas = live & (opcode == OP_VALUE_GET_AND_SET)
     is_add = live & (opcode == OP_LONG_ADD)
+    cas_hit = is_cas & (eff == a)
+    # Only ops that actually write may touch value/val_dl — a failed CAS
+    # must leave an active TTL intact.
+    wrote = is_set | cas_hit | is_gas | is_add
+    purge = (is_get | is_cas) & expired  # observed expiry without writing
 
-    cas_hit = is_cas & (value == a)
-
-    new_value = value
+    new_value = eff
     new_value = jnp.where(is_set, a, new_value)
     new_value = jnp.where(cas_hit, b, new_value)
     new_value = jnp.where(is_gas, a, new_value)
-    new_value = jnp.where(is_add, value + a, new_value)
+    new_value = jnp.where(is_add, eff + a, new_value)
+    value = jnp.where(wrote, new_value, jnp.where(purge, 0, value))
+    new_dl = jnp.where(is_set & (c > 0), now + c, 0)
+    val_dl = jnp.where(wrote, new_dl, jnp.where(purge, 0, val_dl))
 
-    result = jnp.zeros_like(value)
-    result = jnp.where(is_get, value, result)
+    result = jnp.where(is_get, eff, result)
     result = jnp.where(is_cas, cas_hit.astype(jnp.int32), result)
-    result = jnp.where(is_gas, value, result)
-    result = jnp.where(is_add, new_value, result)
+    result = jnp.where(is_gas, eff, result)
+    result = jnp.where(is_add, eff + a, result)
 
-    return res._replace(value=new_value), result
+    # ---- map --------------------------------------------------------------
+    mk, mv, ml, mdl = res.map_key, res.map_val, res.map_live, res.map_dl
+    m_alive = ml & ((mdl == 0) | (mdl > now[..., None]))
+    m_free = ~m_alive
+    is_map = live & (opcode >= OP_MAP_PUT) & (opcode <= OP_MAP_CLEAR)
+    hit = m_alive & (mk == a[..., None])
+    hit_idx, hit_any = _first_true(hit)
+    free_idx, free_any = _first_true(m_free)
+    old = jnp.where(hit_any, _gather3(mv, hit_idx), 0)
+
+    def mop(code):
+        return live & (opcode == code)
+
+    put = mop(OP_MAP_PUT)
+    pia = mop(OP_MAP_PUT_IF_ABSENT)
+    rep = mop(OP_MAP_REPLACE)
+    repif = mop(OP_MAP_REPLACE_IF) & hit_any & (old == b)
+    write_new = (put | pia) & ~hit_any           # needs a free slot
+    write_over = (put & hit_any) | rep & hit_any | repif
+    ins_ok = write_new & free_any
+    w_idx = jnp.where(hit_any, hit_idx, free_idx)
+    w_val = jnp.where(repif, c, b)
+    w_dl = jnp.where((put | pia) & (c > 0), now + c, 0)
+    do_write = ins_ok | write_over
+    mk = _scatter3(mk, w_idx, do_write, a)
+    mv = _scatter3(mv, w_idx, do_write, w_val)
+    mdl = _scatter3(mdl, w_idx, do_write, jnp.where(write_over & ~put, 0, w_dl))
+    ml = _scatter3(ml, w_idx, do_write, jnp.ones_like(a, bool))
+
+    rm = mop(OP_MAP_REMOVE) | (mop(OP_MAP_REMOVE_IF) & (old == b))
+    ml = _scatter3(ml, hit_idx, rm & hit_any, jnp.zeros_like(a, bool))
+    clear = mop(OP_MAP_CLEAR)
+    ml = jnp.where(clear[..., None], False, ml)
+    # drop expired slots whenever any map op touches the group (lazy purge;
+    # just-written slots have dl == 0 or dl > now, so they always survive)
+    ml = jnp.where(is_map[..., None],
+                   ml & ((mdl == 0) | (mdl > now[..., None])), ml)
+
+    m_size = jnp.sum(m_alive, axis=-1).astype(jnp.int32)
+    result = jnp.where(put, old, result)
+    result = jnp.where(put & write_new & ~free_any, INT_MIN, result)
+    result = jnp.where(pia, jnp.where(hit_any, 0,
+                       jnp.where(free_any, 1, INT_MIN)), result)
+    result = jnp.where(mop(OP_MAP_GET), old, result)
+    result = jnp.where(mop(OP_MAP_GET_OR_DEFAULT),
+                       jnp.where(hit_any, old, b), result)
+    result = jnp.where(mop(OP_MAP_REMOVE), old, result)
+    result = jnp.where(mop(OP_MAP_REMOVE_IF),
+                       (hit_any & (old == b)).astype(jnp.int32), result)
+    result = jnp.where(rep, jnp.where(hit_any, old, INT_MIN), result)
+    result = jnp.where(mop(OP_MAP_REPLACE_IF), repif.astype(jnp.int32), result)
+    result = jnp.where(mop(OP_MAP_CONTAINS_KEY), hit_any.astype(jnp.int32),
+                       result)
+    result = jnp.where(mop(OP_MAP_CONTAINS_VALUE),
+                       jnp.any(m_alive & (mv == a[..., None]),
+                               axis=-1).astype(jnp.int32), result)
+    result = jnp.where(mop(OP_MAP_SIZE), m_size, result)
+    result = jnp.where(mop(OP_MAP_IS_EMPTY), (m_size == 0).astype(jnp.int32),
+                       result)
+
+    # ---- set --------------------------------------------------------------
+    sk, sl, sdl = res.set_key, res.set_live, res.set_dl
+    s_alive = sl & ((sdl == 0) | (sdl > now[..., None]))
+    s_hit = s_alive & (sk == a[..., None])
+    s_hit_idx, s_hit_any = _first_true(s_hit)
+    s_free_idx, s_free_any = _first_true(~s_alive)
+
+    def sop(code):
+        return live & (opcode == code)
+
+    add = sop(OP_SET_ADD) & ~s_hit_any & s_free_any
+    sk = _scatter3(sk, s_free_idx, add, a)
+    sdl = _scatter3(sdl, s_free_idx, add,
+                    jnp.where(c > 0, now + c, 0))
+    sl = _scatter3(sl, s_free_idx, add, jnp.ones_like(a, bool))
+    srm = sop(OP_SET_REMOVE) & s_hit_any
+    sl = _scatter3(sl, s_hit_idx, srm, jnp.zeros_like(a, bool))
+    sl = jnp.where(sop(OP_SET_CLEAR)[..., None], False, sl)
+    is_setop = live & (opcode >= OP_SET_ADD) & (opcode <= OP_SET_CLEAR)
+    sl = jnp.where(is_setop[..., None],
+                   sl & ((sdl == 0) | (sdl > now[..., None])), sl)
+    s_size = jnp.sum(s_alive, axis=-1).astype(jnp.int32)
+    result = jnp.where(sop(OP_SET_ADD),
+                       jnp.where(s_hit_any, 0,
+                                 jnp.where(s_free_any, 1, INT_MIN)), result)
+    result = jnp.where(sop(OP_SET_REMOVE), s_hit_any.astype(jnp.int32), result)
+    result = jnp.where(sop(OP_SET_CONTAINS), s_hit_any.astype(jnp.int32),
+                       result)
+    result = jnp.where(sop(OP_SET_SIZE), s_size, result)
+
+    # ---- queue ------------------------------------------------------------
+    qv, qh, qs = res.q_val, res.q_head, res.q_size
+    Q = qv.shape[-1]
+
+    def qop(code):
+        return live & (opcode == code)
+
+    offer = qop(OP_Q_OFFER)
+    can_push = offer & (qs < Q)
+    qv = _scatter3(qv, (qh + qs) % Q, can_push, a)
+    head_val = _gather3(qv, qh % Q)
+    poll = qop(OP_Q_POLL) & (qs > 0)
+    qs = jnp.where(can_push, qs + 1, qs)
+    qh = jnp.where(poll, qh + 1, qh)
+    qs = jnp.where(poll, qs - 1, qs)
+    qs = jnp.where(qop(OP_Q_CLEAR), 0, qs)
+    result = jnp.where(offer, can_push.astype(jnp.int32), result)
+    result = jnp.where(qop(OP_Q_POLL),
+                       jnp.where(poll, head_val, INT_MIN), result)
+    result = jnp.where(qop(OP_Q_PEEK),
+                       jnp.where(qs > 0, head_val, INT_MIN), result)
+    result = jnp.where(qop(OP_Q_SIZE), qs, result)
+
+    # ---- lock -------------------------------------------------------------
+    holder = res.lk_holder
+    wid, wdl, wlv = res.lk_wait_id, res.lk_wait_dl, res.lk_wait_live
+    lh, ls = res.lk_head, res.lk_size
+    W = wid.shape[-1]
+    is_lock = live & (opcode >= OP_LOCK_ACQUIRE) & (opcode <= OP_LOCK_HOLDER)
+
+    # Lazily expire timed-out waiters, then compact the ring: dead slots
+    # (cancelled or expired anywhere in the window) must never wedge
+    # capacity. Stable argsort keeps FIFO order of the live entries.
+    pos = _ring_pos(lh, W)
+    in_win = pos < ls[..., None]
+    wlv = wlv & ~(is_lock[..., None] & in_win & (wdl <= now[..., None]))
+    live_win = wlv & in_win
+    any_dead = is_lock & jnp.any(in_win & ~wlv, axis=-1)
+    order = jnp.argsort(jnp.where(live_win, pos, W + pos), axis=-1)
+    count = jnp.sum(live_win, axis=-1).astype(jnp.int32)
+    dead3 = any_dead[..., None]
+    wid = jnp.where(dead3, jnp.take_along_axis(wid, order, axis=-1), wid)
+    wdl = jnp.where(dead3, jnp.take_along_axis(wdl, order, axis=-1), wdl)
+    wlv = jnp.where(dead3, jnp.arange(W)[None, None, :] < count[..., None],
+                    wlv)
+    lh = jnp.where(any_dead, 0, lh)
+    ls = jnp.where(any_dead, count, ls)
+
+    acq = live & (opcode == OP_LOCK_ACQUIRE)
+    rel = live & (opcode == OP_LOCK_RELEASE)
+    cxl = live & (opcode == OP_LOCK_CANCEL)
+
+    pos2 = _ring_pos(lh, W)
+    in_win2 = pos2 < ls[..., None]
+    queued_me = jnp.any(wlv & in_win2 & (wid == a[..., None]), axis=-1)
+    held_by_me = holder == a
+
+    grant_now = acq & (holder == -1)
+    holder = jnp.where(grant_now, a, holder)
+    idem = acq & held_by_me          # retried acquire we already won
+    want_q = acq & ~grant_now & ~idem & ~queued_me & (b != 0)
+    q_ok = want_q & (ls < W)
+    q_dl = jnp.where(b < 0, INT_MAX, now + b)
+    wid = _scatter3(wid, (lh + ls) % W, q_ok, a)
+    wdl = _scatter3(wdl, (lh + ls) % W, q_ok, q_dl)
+    wlv = _scatter3(wlv, (lh + ls) % W, q_ok, jnp.ones_like(a, bool))
+    ls = jnp.where(q_ok, ls + 1, ls)
+
+    # release: hand to the first waiter (ring is compacted: head is live)
+    do_rel = rel & held_by_me
+    next_id = _gather3(wid, lh % W)
+    has_next = do_rel & (ls > 0)
+    holder = jnp.where(do_rel, jnp.where(has_next, next_id, -1), holder)
+    lh = jnp.where(has_next, lh + 1, lh)
+    ls = jnp.where(has_next, ls - 1, ls)
+
+    # cancel: totally ordered with grants through the log, so the client's
+    # timeout decision is race-free (result 2 = you won before cancelling)
+    already = cxl & held_by_me
+    cxl_hit = wlv & in_win2 & (wid == a[..., None])
+    cxl_idx, cxl_found = _first_true(cxl_hit)
+    wlv = _scatter3(wlv, cxl_idx, cxl & ~already & cxl_found,
+                    jnp.zeros_like(a, bool))
+
+    result = jnp.where(acq, jnp.where(
+        grant_now | idem, 1,
+        jnp.where(q_ok | queued_me, 2, 0)), result)
+    result = jnp.where(rel, do_rel.astype(jnp.int32), result)
+    result = jnp.where(cxl, jnp.where(already, 2,
+                       jnp.where(cxl_found, 1, 0)), result)
+    result = jnp.where(live & (opcode == OP_LOCK_HOLDER), holder, result)
+
+    # Only queued-waiter grants are asynchronous; an immediate grant or
+    # failure reaches the client as the command's own result, so no event
+    # is emitted (a stale event could be misread by a later attempt).
+    ev_mask = ev_mask | has_next
+    ev_code = jnp.where(has_next, EV_LOCK_GRANT, ev_code)
+    ev_target = jnp.where(has_next, next_id, ev_target)
+    ev_arg = jnp.where(has_next, 1, ev_arg)
+
+    # ---- leader election --------------------------------------------------
+    el, ep = res.el_leader, res.el_epoch
+    eid, elv, eh, es = res.el_id, res.el_live, res.el_head, res.el_size
+    Wl = eid.shape[-1]
+    is_el = live & (opcode >= OP_ELECT_LISTEN) & (opcode <= OP_ELECT_GET_EPOCH)
+
+    # compact out unlisted waiters (same discipline as the lock ring)
+    e_pos = _ring_pos(eh, Wl)
+    e_in = e_pos < es[..., None]
+    e_live_win = elv & e_in
+    e_dead = is_el & jnp.any(e_in & ~elv, axis=-1)
+    e_order = jnp.argsort(jnp.where(e_live_win, e_pos, Wl + e_pos), axis=-1)
+    e_count = jnp.sum(e_live_win, axis=-1).astype(jnp.int32)
+    ed3 = e_dead[..., None]
+    eid = jnp.where(ed3, jnp.take_along_axis(eid, e_order, axis=-1), eid)
+    elv = jnp.where(ed3, jnp.arange(Wl)[None, None, :] < e_count[..., None],
+                    elv)
+    eh = jnp.where(e_dead, 0, eh)
+    es = jnp.where(e_dead, e_count, es)
+
+    listen = live & (opcode == OP_ELECT_LISTEN)
+    resign = live & (opcode == OP_ELECT_RESIGN)
+    isldr = live & (opcode == OP_ELECT_IS_LEADER)
+
+    e_pos2 = _ring_pos(eh, Wl)
+    e_in2 = e_pos2 < es[..., None]
+    listed = jnp.any(elv & e_in2 & (eid == a[..., None]), axis=-1)
+    am_leader = el == a
+
+    vacant = el == -1
+    win_now = listen & vacant
+    el = jnp.where(win_now, a, el)
+    ep = jnp.where(win_now, index, ep)
+    # a retried listen by the sitting leader or a queued waiter is
+    # idempotent — no duplicate ring entry
+    el_q = listen & ~vacant & ~am_leader & ~listed & (es < Wl)
+    eid = _scatter3(eid, (eh + es) % Wl, el_q, a)
+    elv = _scatter3(elv, (eh + es) % Wl, el_q, jnp.ones_like(a, bool))
+    es = jnp.where(el_q, es + 1, es)
+    el_full = listen & ~vacant & ~am_leader & ~listed & ~el_q
+
+    # resign by the leader promotes the next listener (FIFO succession,
+    # LeaderElectionState.close:36-49); resign by a waiter unlists it
+    do_res = resign & am_leader
+    succ_id = _gather3(eid, eh % Wl)
+    has_succ = do_res & (es > 0)
+    el = jnp.where(do_res, jnp.where(has_succ, succ_id, -1), el)
+    ep = jnp.where(has_succ, index, ep)
+    eh = jnp.where(has_succ, eh + 1, eh)
+    es = jnp.where(has_succ, es - 1, es)
+    e_hit = elv & e_in2 & (eid == a[..., None])
+    e_idx, e_found = _first_true(e_hit)
+    elv = _scatter3(elv, e_idx, resign & ~do_res & e_found,
+                    jnp.zeros_like(a, bool))
+
+    result = jnp.where(listen, jnp.where(win_now, index,
+                       jnp.where(am_leader, ep,
+                       jnp.where(el_full, INT_MIN, 0))), result)
+    result = jnp.where(resign, do_res.astype(jnp.int32), result)
+    result = jnp.where(isldr, (am_leader & (ep == b)).astype(jnp.int32),
+                       result)
+    result = jnp.where(live & (opcode == OP_ELECT_LEADER), el, result)
+    result = jnp.where(live & (opcode == OP_ELECT_GET_EPOCH), ep, result)
+
+    # Symmetric with locks: an immediate win is the listen command's own
+    # result; only FIFO promotions are delivered as events.
+    ev_mask = ev_mask | has_succ
+    ev_code = jnp.where(has_succ, EV_ELECT, ev_code)
+    ev_target = jnp.where(has_succ, succ_id, ev_target)
+    ev_arg = jnp.where(has_succ, index, ev_arg)
+
+    # ---- push the (single) session event into the outbox ring -------------
+    evc, evt, eva = res.ev_code, res.ev_target, res.ev_arg
+    evh, evtl = res.ev_head, res.ev_tail
+    E = evc.shape[-1]
+    overflow = ev_mask & ((evtl - evh) >= E)
+    evh = jnp.where(overflow, evh + 1, evh)  # drop oldest
+    slot = evtl % E
+    evc = _scatter3(evc, slot, ev_mask, ev_code)
+    evt = _scatter3(evt, slot, ev_mask, ev_target)
+    eva = _scatter3(eva, slot, ev_mask, ev_arg)
+    evtl = jnp.where(ev_mask, evtl + 1, evtl)
+
+    new_res = ResourceState(
+        value=value, val_dl=val_dl,
+        map_key=mk, map_val=mv, map_live=ml, map_dl=mdl,
+        set_key=sk, set_live=sl, set_dl=sdl,
+        q_val=qv, q_head=qh, q_size=qs,
+        lk_holder=holder, lk_wait_id=wid, lk_wait_dl=wdl, lk_wait_live=wlv,
+        lk_head=lh, lk_size=ls,
+        el_leader=el, el_epoch=ep, el_id=eid, el_live=elv,
+        el_head=eh, el_size=es,
+        ev_code=evc, ev_target=evt, ev_arg=eva, ev_head=evh, ev_tail=evtl,
+    )
+    return new_res, result
+
+
+def drain_events(res: ResourceState, n: int, mask: jnp.ndarray
+                 ) -> tuple[ResourceState, tuple[jnp.ndarray, ...]]:
+    """Pop up to ``n`` oldest events from each lane's outbox ring where
+    ``mask`` ([G] bool — group has an active leader) holds.
+
+    Returns ``(new_state, (seq, code, target, arg, valid))``, each
+    ``[G,P,n]``. Lanes of a group pop in lockstep (deterministic); the
+    caller harvests the leader lane and dedups by absolute ``seq``. Gating
+    on an active leader means events emitted during leaderless rounds stay
+    queued until someone can deliver them (at-least-once).
+    """
+    evh, evtl = res.ev_head, res.ev_tail
+    E = res.ev_code.shape[-1]
+    lane_mask = mask[:, None]
+    seqs, codes, targets, args, valids = [], [], [], [], []
+    for i in range(n):
+        seq = evh + i
+        ok = lane_mask & (seq < evtl)
+        slot = seq % E
+        seqs.append(seq)
+        codes.append(jnp.where(ok, _gather3(res.ev_code, slot), 0))
+        targets.append(jnp.where(ok, _gather3(res.ev_target, slot), 0))
+        args.append(jnp.where(ok, _gather3(res.ev_arg, slot), 0))
+        valids.append(ok)
+    new_head = jnp.where(lane_mask, jnp.minimum(evh + n, evtl), evh)
+    out = tuple(jnp.stack(x, axis=-1) for x in
+                (seqs, codes, targets, args, valids))
+    return res._replace(ev_head=new_head), out

@@ -42,7 +42,13 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .apply import ResourceState, apply_entry, init_resources
+from .apply import (
+    ResourceConfig,
+    ResourceState,
+    apply_entry,
+    drain_events,
+    init_resources,
+)
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 
@@ -55,6 +61,9 @@ class RaftState(NamedTuple):
     role: jnp.ndarray          # [G,P] i32 ∈ {FOLLOWER, CANDIDATE, LEADER}
     leader_hint: jnp.ndarray   # [G,P] i32 peer index, -1 = unknown
     timer: jnp.ndarray         # [G,P] i32 rounds until election timeout
+    clock: jnp.ndarray         # [G,P] i32 logical round clock (replicated —
+    #                            identical in every lane; stamps log entries
+    #                            so TTL/timeout evaluation is deterministic)
     last_index: jnp.ndarray    # [G,P] i32
     commit_index: jnp.ndarray  # [G,P] i32
     applied_index: jnp.ndarray  # [G,P] i32
@@ -64,6 +73,8 @@ class RaftState(NamedTuple):
     log_op: jnp.ndarray        # [G,P,L] i32 opcode
     log_a: jnp.ndarray         # [G,P,L] i32 arg
     log_b: jnp.ndarray         # [G,P,L] i32 arg
+    log_c: jnp.ndarray         # [G,P,L] i32 arg
+    log_time: jnp.ndarray      # [G,P,L] i32 logical timestamp at append
     log_tag: jnp.ndarray       # [G,P,L] i32 host correlation tag
     resources: ResourceState
 
@@ -74,6 +85,7 @@ class Submits(NamedTuple):
     opcode: jnp.ndarray  # [G,S] i32
     a: jnp.ndarray       # [G,S] i32
     b: jnp.ndarray       # [G,S] i32
+    c: jnp.ndarray       # [G,S] i32
     tag: jnp.ndarray     # [G,S] i32
     valid: jnp.ndarray   # [G,S] bool
 
@@ -86,6 +98,14 @@ class StepOutputs(NamedTuple):
     leader: jnp.ndarray      # [G] i32 leader peer at round start (-1 none)
     commit_index: jnp.ndarray  # [G] i32 leader commit after the round
     stale: jnp.ndarray       # [G,P] bool — lagging beyond ring window
+    clock: jnp.ndarray       # [G] i32 post-step logical clock
+    # session events drained from the leader lane's outbox ring; host dedups
+    # by seq (at-least-once across leader changes)
+    ev_seq: jnp.ndarray      # [G,D] i32
+    ev_code: jnp.ndarray     # [G,D] i32
+    ev_target: jnp.ndarray   # [G,D] i32
+    ev_arg: jnp.ndarray      # [G,D] i32
+    ev_valid: jnp.ndarray    # [G,D] bool
 
 
 class Config(NamedTuple):
@@ -95,6 +115,8 @@ class Config(NamedTuple):
     applies_per_round: int = 4
     timer_min: int = 4        # election timeout in rounds (randomized range)
     timer_max: int = 9
+    events_per_round: int = 4  # outbox events drained per step
+    resource: ResourceConfig = ResourceConfig()
 
 
 def init_state(num_groups: int, num_peers: int, log_slots: int,
@@ -106,17 +128,20 @@ def init_state(num_groups: int, num_peers: int, log_slots: int,
     return RaftState(
         term=z2, voted_for=z2 - 1, role=z2 + FOLLOWER, leader_hint=z2 - 1,
         timer=jax.random.randint(key, (G, P), config.timer_min, config.timer_max),
+        clock=z2,
         last_index=z2, commit_index=z2, applied_index=z2,
         next_index=z3 + 1, match_index=z3,
-        log_term=zl, log_op=zl, log_a=zl, log_b=zl, log_tag=zl,
-        resources=init_resources(G, P),
+        log_term=zl, log_op=zl, log_a=zl, log_b=zl, log_c=zl,
+        log_time=zl, log_tag=zl,
+        resources=init_resources(G, P, config.resource),
     )
 
 
 def make_submits(num_groups: int, submit_slots: int) -> Submits:
     G, S = num_groups, submit_slots
     z = jnp.zeros((G, S), jnp.int32)
-    return Submits(opcode=z, a=z, b=z, tag=z, valid=jnp.zeros((G, S), bool))
+    return Submits(opcode=z, a=z, b=z, c=z, tag=z,
+                   valid=jnp.zeros((G, S), bool))
 
 
 def full_delivery(num_groups: int, num_peers: int) -> jnp.ndarray:
@@ -202,8 +227,8 @@ def install_snapshots(state: RaftState, stale: jnp.ndarray,
         # next/match are as-owner state: unused until this lane wins an
         # election, which reinitializes them — leave untouched.
         log_term=cp(state.log_term), log_op=cp(state.log_op),
-        log_a=cp(state.log_a), log_b=cp(state.log_b),
-        log_tag=cp(state.log_tag),
+        log_a=cp(state.log_a), log_b=cp(state.log_b), log_c=cp(state.log_c),
+        log_time=cp(state.log_time), log_tag=cp(state.log_tag),
         resources=jax.tree.map(cp, state.resources),
     )
 
@@ -222,6 +247,11 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
     quorum = P // 2 + 1
     peer_ids = jnp.arange(P)
     g_ids = jnp.arange(G)
+
+    # Replicated logical clock: +1 per step in every lane, so entry
+    # timestamps (and thus TTL/timeout evaluation) are identical on every
+    # replica (SURVEY.md §7.3 #3 — never wall clock inside the kernel).
+    clock1 = state.clock + 1
 
     # Self-delivery is always on (a node talks to itself).
     deliver = deliver | jnp.eye(P, dtype=bool)[None]
@@ -243,7 +273,10 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
     l_log_op = _peer_view(state.log_op, lead)
     l_log_a = _peer_view(state.log_a, lead)
     l_log_b = _peer_view(state.log_b, lead)
+    l_log_c = _peer_view(state.log_c, lead)
+    l_log_time = _peer_view(state.log_time, lead)
     l_log_tag = _peer_view(state.log_tag, lead)
+    l_clock = jnp.max(clock1, axis=1)              # [G] (identical per lane)
 
     # ---- phase 1: inject client submits into the leader log ----
     # Backpressure: never let the ring overwrite entries the leader itself or
@@ -267,6 +300,10 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
             jnp.where(m, submits.a[:, s], l_log_a[g_ids, slot]))
         l_log_b = l_log_b.at[g_ids, slot].set(
             jnp.where(m, submits.b[:, s], l_log_b[g_ids, slot]))
+        l_log_c = l_log_c.at[g_ids, slot].set(
+            jnp.where(m, submits.c[:, s], l_log_c[g_ids, slot]))
+        l_log_time = l_log_time.at[g_ids, slot].set(
+            jnp.where(m, l_clock, l_log_time[g_ids, slot]))
         l_log_tag = l_log_tag.at[g_ids, slot].set(
             jnp.where(m, submits.tag[:, s], l_log_tag[g_ids, slot]))
     l_last = l_last + accepted.sum(axis=1, dtype=jnp.int32)
@@ -306,6 +343,7 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
 
     log_term2, log_op2 = state.log_term, state.log_op
     log_a2, log_b2, log_tag2 = state.log_a, state.log_b, state.log_tag
+    log_c2, log_time2 = state.log_c, state.log_time
     for e in range(E):
         idx = prev + 1 + e
         send = match & (idx <= upto)
@@ -314,12 +352,16 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
         ent_op = jnp.take_along_axis(l_log_op, slot_l, axis=1)
         ent_a = jnp.take_along_axis(l_log_a, slot_l, axis=1)
         ent_b = jnp.take_along_axis(l_log_b, slot_l, axis=1)
+        ent_c = jnp.take_along_axis(l_log_c, slot_l, axis=1)
+        ent_time = jnp.take_along_axis(l_log_time, slot_l, axis=1)
         ent_tag = jnp.take_along_axis(l_log_tag, slot_l, axis=1)
         slot_f = slot_l  # same absolute index → same ring slot
         log_term2 = _slot_write(log_term2, slot_f, send, ent_term)
         log_op2 = _slot_write(log_op2, slot_f, send, ent_op)
         log_a2 = _slot_write(log_a2, slot_f, send, ent_a)
         log_b2 = _slot_write(log_b2, slot_f, send, ent_b)
+        log_c2 = _slot_write(log_c2, slot_f, send, ent_c)
+        log_time2 = _slot_write(log_time2, slot_f, send, ent_time)
         log_tag2 = _slot_write(log_tag2, slot_f, send, ent_tag)
 
     entries_sent = match & (upto >= prev + 1)
@@ -370,6 +412,8 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
     log_op2 = _scatter_lane(log_op2, lead, active, l_log_op)
     log_a2 = _scatter_lane(log_a2, lead, active, l_log_a)
     log_b2 = _scatter_lane(log_b2, lead, active, l_log_b)
+    log_c2 = _scatter_lane(log_c2, lead, active, l_log_c)
+    log_time2 = _scatter_lane(log_time2, lead, active, l_log_time)
     log_tag2 = _scatter_lane(log_tag2, lead, active, l_log_tag)
 
     # ---- phase 4: election timers + RequestVote tally ----
@@ -422,42 +466,58 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
     noop_slot = (noop_idx - 1) % L
     log_term2 = _slot_write(log_term2, noop_slot, won, term_v)
     log_op2 = _slot_write(log_op2, noop_slot, won, jnp.zeros_like(term_v))
+    log_time2 = _slot_write(log_time2, noop_slot, won, clock1)
     log_tag2 = _slot_write(log_tag2, noop_slot, won, jnp.zeros_like(term_v))
     last_f = jnp.where(won, noop_idx, last2)
 
     # ---- phase 5: apply committed entries (all replicas, A per round) ----
-    resources = state.resources
-    applied = state.applied_index
-    out_valid = jnp.zeros((G, A), bool)
-    out_tag = jnp.zeros((G, A), jnp.int32)
-    out_result = jnp.zeros((G, A), jnp.int32)
-    for i in range(A):
+    # lax.scan keeps the compiled program one apply-kernel big, not A× big.
+    def _apply_one(carry, _):
+        resources, applied = carry
         idx = applied + 1
         do = idx <= commit2
         slot = ((idx - 1) % L)[..., None]
         op_i = jnp.take_along_axis(log_op2, slot, axis=2).squeeze(-1)
         a_i = jnp.take_along_axis(log_a2, slot, axis=2).squeeze(-1)
         b_i = jnp.take_along_axis(log_b2, slot, axis=2).squeeze(-1)
+        c_i = jnp.take_along_axis(log_c2, slot, axis=2).squeeze(-1)
+        time_i = jnp.take_along_axis(log_time2, slot, axis=2).squeeze(-1)
         tag_i = jnp.take_along_axis(log_tag2, slot, axis=2).squeeze(-1)
-        resources, result = apply_entry(resources, op_i, a_i, b_i, do)
+        resources, result = apply_entry(
+            resources, op_i, a_i, b_i, c_i, idx, time_i, do)
         applied = jnp.where(do, idx, applied)
         lead_do = _peer_view(do, lead) & active
-        out_valid = out_valid.at[:, i].set(lead_do)
-        out_tag = out_tag.at[:, i].set(
-            jnp.where(lead_do, _peer_view(tag_i, lead), 0))
-        out_result = out_result.at[:, i].set(
+        return (resources, applied), (
+            lead_do, jnp.where(lead_do, _peer_view(tag_i, lead), 0),
             jnp.where(lead_do, _peer_view(result, lead), 0))
+
+    (resources, applied), (ov, ot, orr) = jax.lax.scan(
+        _apply_one, (state.resources, state.applied_index), None, length=A)
+    out_valid = jnp.moveaxis(ov, 0, 1)   # [A,G] -> [G,A]
+    out_tag = jnp.moveaxis(ot, 0, 1)
+    out_result = jnp.moveaxis(orr, 0, 1)
+
+    # ---- phase 6: drain session events (leader lane → host) --------------
+    # Gated on an active leader so events emitted during leaderless rounds
+    # are not popped unseen.
+    resources, (ev_seq, ev_code, ev_target, ev_arg, ev_ok) = drain_events(
+        resources, config.events_per_round, active)
+    lead_ev = active[:, None] & _peer_view(ev_ok, lead)
 
     new_state = RaftState(
         term=jnp.maximum(term_v, term_e), voted_for=voted_v, role=role_f,
-        leader_hint=hint_f, timer=timer1,
+        leader_hint=hint_f, timer=timer1, clock=clock1,
         last_index=last_f, commit_index=commit2, applied_index=applied,
         next_index=next2, match_index=match2,
         log_term=log_term2, log_op=log_op2, log_a=log_a2, log_b=log_b2,
+        log_c=log_c2, log_time=log_time2,
         log_tag=log_tag2, resources=resources)
     outputs = StepOutputs(
         accepted=accepted, out_valid=out_valid, out_tag=out_tag,
         out_result=out_result, leader=lead,
         commit_index=jnp.where(active, l_commit, jnp.max(commit2, axis=1)),
-        stale=stale)
+        stale=stale, clock=l_clock,
+        ev_seq=_peer_view(ev_seq, lead), ev_code=_peer_view(ev_code, lead),
+        ev_target=_peer_view(ev_target, lead),
+        ev_arg=_peer_view(ev_arg, lead), ev_valid=lead_ev)
     return new_state, outputs

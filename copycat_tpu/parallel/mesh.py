@@ -34,25 +34,20 @@ def make_mesh(groups: int | None = None, peers: int | None = None,
     return Mesh(dev, ("groups", "peers"))
 
 
-def raft_specs(mesh: Mesh) -> RaftState:
-    """Per-field PartitionSpecs: group axis sharded, peer axis sharded when
-    the mesh has a ``peers`` axis, log/ring axes replicated."""
+def raft_specs(mesh: Mesh, state: RaftState) -> RaftState:
+    """Per-leaf PartitionSpecs: group axis sharded, peer axis sharded when
+    the mesh has a ``peers`` axis, log/ring/pool axes replicated.
+
+    Every ``RaftState`` leaf (including all resource pools and the event
+    ring) is laid out ``[G, P, ...]``, so one rule covers the whole tree."""
     g = "groups" if "groups" in mesh.axis_names else None
     p = "peers" if "peers" in mesh.axis_names else None
-    s2 = P(g, p)        # [G,P]
-    s3 = P(g, p, None)  # [G,P,P] (owner axis sharded) and [G,P,L]
-    from ..ops.apply import ResourceState
-    return RaftState(
-        term=s2, voted_for=s2, role=s2, leader_hint=s2, timer=s2,
-        last_index=s2, commit_index=s2, applied_index=s2,
-        next_index=s3, match_index=s3,
-        log_term=s3, log_op=s3, log_a=s3, log_b=s3, log_tag=s3,
-        resources=ResourceState(value=s2),
-    )
+    return jax.tree.map(
+        lambda x: P(g, p, *([None] * (x.ndim - 2))), state)
 
 
 def shard_state(state: RaftState, mesh: Mesh) -> RaftState:
-    specs = raft_specs(mesh)
+    specs = raft_specs(mesh, state)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs)
 
