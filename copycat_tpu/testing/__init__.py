@@ -1,0 +1,26 @@
+"""Verification harness: linearizability checking + fault injection.
+
+The reference outsources consistency verification to an external Jepsen
+suite (``/root/reference/README.md:8,27-30``); SURVEY.md §4 lists an
+in-tree checker as a build obligation. This package provides:
+
+- :mod:`linearize` — a Wing & Gong style linearizability checker over
+  recorded operation histories with sequential models for the device
+  resource types;
+- :mod:`nemesis` — fault schedules (partitions, message loss, leader
+  isolation) expressed as ``deliver[g, from, to]`` masks, injected *inside*
+  the compiled consensus step;
+- :mod:`history` — a recorder that drives ``RaftGroups`` with concurrent
+  clients and captures invoke/complete windows for the checker.
+"""
+
+from .linearize import (  # noqa: F401
+    CounterModel,
+    HOp,
+    LockModel,
+    MapModel,
+    RegisterModel,
+    check_linearizable,
+)
+from .nemesis import Nemesis  # noqa: F401
+from .history import HistoryRecorder  # noqa: F401

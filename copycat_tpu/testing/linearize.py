@@ -1,0 +1,151 @@
+"""Wing & Gong linearizability checker with sequential resource models.
+
+The reference relies on the external ``atomix-jepsen`` suite for this
+(``/root/reference/README.md:27-30``); SURVEY.md §4 names an in-tree
+checker as a build obligation. The algorithm is the classic Wing & Gong
+search with Lowe's memoization: try every *minimal* pending operation (one
+no other op completed before its invocation), advance the sequential model,
+and backtrack on result mismatch. Histories record real-time windows
+``[invoke, complete]`` in driver rounds; incomplete operations (crashed
+clients) may linearize at any point or never.
+
+Models mirror the device kernels' result conventions (``ops/apply.py``)
+so recorded raw int results can be checked without translation.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class HOp:
+    """One operation in a history."""
+
+    op_id: int
+    op: tuple              # model operation, e.g. ("cas", expect, update)
+    result: int | None     # raw result; None = unknown (never completed)
+    invoke: float          # round at submission
+    complete: float = math.inf  # round at completion (inf = incomplete)
+
+
+class RegisterModel:
+    """Linearizable int register (device value/long kernel semantics)."""
+
+    init = 0
+
+    @staticmethod
+    def apply(state: int, op: tuple) -> tuple[int, int]:
+        name = op[0]
+        if name == "set":
+            return op[1], 0
+        if name == "get":
+            return state, state
+        if name == "cas":
+            if state == op[1]:
+                return op[2], 1
+            return state, 0
+        if name == "gas":
+            return op[1], state
+        if name == "add":
+            return state + op[1], state + op[1]
+        raise ValueError(f"unknown register op {name}")
+
+
+class CounterModel(RegisterModel):
+    """Alias — add/get over an int (DistributedAtomicLong semantics)."""
+
+
+class MapModel:
+    """int→int map; state is a hashable frozenset of items."""
+
+    init = frozenset()
+
+    @staticmethod
+    def apply(state: frozenset, op: tuple):
+        d = dict(state)
+        name = op[0]
+        if name == "put":
+            old = d.get(op[1], 0)
+            d[op[1]] = op[2]
+            return frozenset(d.items()), old
+        if name == "get":
+            return state, d.get(op[1], 0)
+        if name == "remove":
+            old = d.pop(op[1], 0)
+            return frozenset(d.items()), old
+        if name == "contains":
+            return state, int(op[1] in d)
+        if name == "size":
+            return state, len(d)
+        raise ValueError(f"unknown map op {name}")
+
+
+class LockModel:
+    """try-lock/unlock histories (synchronous results only)."""
+
+    init = -1  # holder id, -1 = free
+
+    @staticmethod
+    def apply(state: int, op: tuple) -> tuple[int, int]:
+        name, who = op[0], op[1]
+        if name == "acquire":        # try-lock: immediate grant or fail;
+            if state in (-1, who):   # re-acquire by the holder is idempotent
+                return who, 1        # (device kernel semantics, apply.py)
+            return state, 0
+        if name == "release":
+            if state == who:
+                return -1, 1
+            return state, 0
+        raise ValueError(f"unknown lock op {name}")
+
+
+@dataclass
+class CheckResult:
+    ok: bool
+    nodes: int
+    witness: list[int] = field(default_factory=list)  # linearization order
+
+
+def check_linearizable(history: list[HOp], model,
+                       max_nodes: int = 2_000_000) -> CheckResult:
+    """Return whether ``history`` is linearizable w.r.t. ``model``.
+
+    Raises ``RuntimeError`` if the search exceeds ``max_nodes`` (history too
+    concurrent to decide) — never returns a false verdict.
+    """
+    by_id = {h.op_id: h for h in history}
+    ids = frozenset(by_id)
+    memo: set = set()
+    nodes = 0
+    order: list[int] = []
+
+    def rec(remaining: frozenset, state) -> bool:
+        nonlocal nodes
+        if all(by_id[i].complete == math.inf for i in remaining):
+            return True  # only incomplete ops left — they may never apply
+        key = (remaining, state)
+        if key in memo:
+            return False
+        nodes += 1
+        if nodes > max_nodes:
+            raise RuntimeError(
+                f"linearizability search exceeded {max_nodes} nodes")
+        min_complete = min(by_id[i].complete for i in remaining)
+        for i in sorted(remaining):
+            h = by_id[i]
+            if h.invoke > min_complete:
+                continue  # some other op completed before this was invoked
+            new_state, res = model.apply(state, h.op)
+            if h.result is not None and res != h.result:
+                continue
+            order.append(i)
+            if rec(remaining - {i}, new_state):
+                return True
+            order.pop()
+        memo.add(key)
+        return False
+
+    ok = rec(ids, model.init)
+    return CheckResult(ok=ok, nodes=nodes, witness=list(order))

@@ -1,0 +1,69 @@
+"""Fault injection for the batched consensus step.
+
+Faults are ``deliver[g, from, to]`` boolean masks consumed *inside* the
+compiled step (``ops/consensus.py`` masks every exchange), so partitions
+and message loss run at full batch speed — the reference's fake-transport
+test strategy (SURVEY.md §4, `LocalTransport`) plus the Jepsen nemesis the
+reference outsources, fused into the XLA program.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+FAULTS = ("heal", "loss", "partition", "isolate")
+
+
+class Nemesis:
+    """Random fault schedule over a ``RaftGroups`` batch.
+
+    Call :meth:`tick` once per driver round; every ``period`` rounds it
+    re-rolls a fault and installs the deliver mask. ``heal()`` restores
+    full connectivity (call before asserting convergence).
+    """
+
+    def __init__(self, rg, seed: int = 0, period: int = 10,
+                 faults: tuple = FAULTS, drop_p: float = 0.3) -> None:
+        self._rg = rg
+        self._rng = np.random.default_rng(seed)
+        self._period = max(1, period)
+        self._faults = faults
+        self._drop_p = drop_p
+        self._rounds = 0
+        self.current = "heal"
+
+    def _mask(self, fault: str) -> np.ndarray:
+        G = self._rg.num_groups
+        P = self._rg.num_peers
+        if fault == "heal":
+            return np.ones((G, P, P), bool)
+        if fault == "loss":
+            return self._rng.random((G, P, P)) > self._drop_p
+        if fault == "partition":
+            side = self._rng.integers(0, 2, (G, P))
+            return side[:, :, None] == side[:, None, :]
+        if fault == "isolate":
+            victim = self._rng.integers(0, P, G)
+            mask = np.ones((G, P, P), bool)
+            g = np.arange(G)
+            mask[g, victim, :] = False
+            mask[g, :, victim] = False
+            return mask
+        raise ValueError(f"unknown fault {fault!r}")
+
+    def tick(self) -> str:
+        """Advance the schedule; installs a fresh fault every period."""
+        if self._rounds % self._period == 0:
+            self.current = str(self._rng.choice(self._faults))
+            self._install(self.current)
+        self._rounds += 1
+        return self.current
+
+    def heal(self) -> None:
+        self.current = "heal"
+        self._install("heal")
+
+    def _install(self, fault: str) -> None:
+        import jax.numpy as jnp
+
+        self._rg.deliver = jnp.asarray(self._mask(fault))
