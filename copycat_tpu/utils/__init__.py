@@ -10,14 +10,19 @@ task-group bound to the shared event loop).
 from .assertions import check_arg, check_not_null, check_state
 from .listeners import Listener, Listeners
 from .managed import Managed
+from .metrics import Counter, Histogram, MetricsRegistry, Timer
 from .scheduled import Scheduled
 
 __all__ = [
     "check_arg",
     "check_not_null",
     "check_state",
+    "Counter",
+    "Histogram",
     "Listener",
     "Listeners",
     "Managed",
+    "MetricsRegistry",
     "Scheduled",
+    "Timer",
 ]

@@ -1,0 +1,105 @@
+"""First-class metrics: counters + latency histograms (ops/sec, p99).
+
+The reference ships no metrics registry (SURVEY.md §5.5 — "build
+obligation: add ops/sec + p99 commit latency counters as first-class";
+they are BASELINE.json's headline metric). Host-side and dependency-free:
+device code stays pure, the driver feeds the registry.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Histogram:
+    """Reservoir-sampled value distribution with exact count/sum."""
+
+    def __init__(self, reservoir: int = 65536, seed: int = 0) -> None:
+        self._values: list[float] = []
+        self._reservoir = reservoir
+        self._rng = random.Random(seed)
+        self.count = 0
+        self.sum = 0.0
+
+    def record(self, value: float) -> None:
+        self.count += 1
+        self.sum += value
+        if len(self._values) < self._reservoir:
+            self._values.append(value)
+        else:
+            i = self._rng.randrange(self.count)
+            if i < self._reservoir:
+                self._values[i] = value
+
+    def percentile(self, p: float) -> float:
+        if not self._values:
+            return 0.0
+        vals = sorted(self._values)
+        idx = min(len(vals) - 1, int(p / 100.0 * len(vals)))
+        return vals[idx]
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+
+class Timer:
+    """Context manager recording elapsed milliseconds into a histogram."""
+
+    def __init__(self, hist: Histogram) -> None:
+        self._hist = hist
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.record((time.perf_counter() - self._t0) * 1e3)
+        return False
+
+
+class MetricsRegistry:
+    """Named counters and histograms with a JSON-able snapshot."""
+
+    def __init__(self) -> None:
+        self._counters: dict[str, Counter] = {}
+        self._histograms: dict[str, Histogram] = {}
+        self._t0 = time.perf_counter()
+
+    def counter(self, name: str) -> Counter:
+        return self._counters.setdefault(name, Counter())
+
+    def histogram(self, name: str) -> Histogram:
+        return self._histograms.setdefault(name, Histogram())
+
+    def timer(self, name: str) -> Timer:
+        return Timer(self.histogram(name))
+
+    def rate(self, name: str) -> float:
+        """Events/sec for a counter since registry creation."""
+        dt = time.perf_counter() - self._t0
+        return self._counters[name].value / dt if dt > 0 else 0.0
+
+    def snapshot(self) -> dict:
+        out: dict = {"uptime_s": round(time.perf_counter() - self._t0, 3)}
+        for name, ctr in self._counters.items():
+            out[name] = ctr.value
+        for name, h in self._histograms.items():
+            out[name] = {
+                "count": h.count,
+                "mean": round(h.mean, 4),
+                "p50": round(h.percentile(50), 4),
+                "p99": round(h.percentile(99), 4),
+            }
+        return out

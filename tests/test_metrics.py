@@ -1,0 +1,54 @@
+"""Metrics subsystem tests (utils/metrics.py + driver wiring)."""
+
+import pytest
+
+from copycat_tpu.utils.metrics import Histogram, MetricsRegistry
+
+
+def test_histogram_percentiles():
+    h = Histogram()
+    for v in range(1, 101):
+        h.record(float(v))
+    assert h.count == 100 and h.mean == pytest.approx(50.5)
+    assert h.percentile(50) == pytest.approx(51.0)
+    assert h.percentile(99) == pytest.approx(100.0)
+    assert Histogram().percentile(99) == 0.0
+
+
+def test_histogram_reservoir_bounded():
+    h = Histogram(reservoir=100)
+    for v in range(10_000):
+        h.record(float(v))
+    assert h.count == 10_000
+    assert len(h._values) == 100
+    assert 0 < h.percentile(50) < 10_000
+
+
+def test_registry_snapshot():
+    reg = MetricsRegistry()
+    reg.counter("ops").inc(5)
+    reg.histogram("lat").record(2.0)
+    with reg.timer("step"):
+        pass
+    snap = reg.snapshot()
+    assert snap["ops"] == 5
+    assert snap["lat"]["count"] == 1 and snap["lat"]["p99"] == 2.0
+    assert snap["step"]["count"] == 1
+    assert reg.rate("ops") > 0
+
+
+def test_driver_records_commit_latency():
+    jax = pytest.importorskip("jax")  # noqa: F841
+    from copycat_tpu.models import RaftGroups
+    from copycat_tpu.ops import apply as ap
+
+    rg = RaftGroups(2, 3, log_slots=32)
+    rg.wait_for_leaders()
+    tags = [rg.submit(0, ap.OP_LONG_ADD, 1) for _ in range(8)]
+    rg.run_until(tags)
+    snap = rg.metrics.snapshot()
+    assert snap["ops_submitted"] == 8
+    assert snap["ops_committed"] == 8
+    lat = snap["commit_latency_rounds"]
+    assert lat["count"] == 8 and lat["p50"] >= 1
+    assert snap["step_wall_ms"]["count"] == rg.rounds
