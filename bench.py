@@ -7,11 +7,20 @@ publishes no numbers (BASELINE.md §published — absence verified), so
 
 Prints ONE JSON line on stdout; all diagnostics go to stderr.
 
-Shape of the run: G groups × 3 peers live on device; leaders are elected,
-then R rounds of the jitted consensus step run under ``lax.scan`` with every
-submit slot full (DistributedLong.addAndGet ops). Each committed entry is a
-quorum-replicated, leader-applied linearizable command; the count is summed
-on device and divided by wall time.
+Scenarios (``COPYCAT_BENCH_SCENARIO``, BASELINE.md benchmark configs):
+
+- ``counter`` (default, config #1 scaled out): every submit slot carries a
+  ``DistributedLong.addAndGet``; G groups × 3 peers; R rounds under
+  ``lax.scan``. Each committed entry is a quorum-replicated, leader-applied
+  linearizable command.
+- ``election`` (config #2): 1k groups; a random peer is isolated every few
+  rounds (device-side nemesis masks), forcing re-elections; measures
+  elections completed/sec (batched RequestVote tally path).
+- ``map`` (config #3): put/get mix through the hashed map apply kernel.
+- ``lock`` (config #4): acquire→queue→release→grant chains in every group
+  (event-push grant path).
+- ``mixed`` (config #5): counter+map+lock mix with per-round random peer
+  isolation (nemesis) across all groups.
 """
 
 from __future__ import annotations
@@ -26,16 +35,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from copycat_tpu.ops.apply import OP_LONG_ADD
+from copycat_tpu.ops import apply as ap
 from copycat_tpu.ops.consensus import (
     Config,
+    LEADER,
     Submits,
     full_delivery,
     init_state,
+    make_submits,
     step,
 )
 
-GROUPS = int(os.environ.get("COPYCAT_BENCH_GROUPS", "10000"))
+SCENARIO = os.environ.get("COPYCAT_BENCH_SCENARIO", "counter")
+GROUPS = int(os.environ.get(
+    "COPYCAT_BENCH_GROUPS", "1000" if SCENARIO == "election" else "10000"))
 PEERS = int(os.environ.get("COPYCAT_BENCH_PEERS", "3"))
 LOG_SLOTS = int(os.environ.get("COPYCAT_BENCH_LOG_SLOTS", "32"))
 ROUNDS = int(os.environ.get("COPYCAT_BENCH_ROUNDS", "200"))
@@ -48,51 +61,138 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> None:
+def empty_submits(G: int) -> Submits:
+    return make_submits(G, SUBMIT_SLOTS)
+
+
+def current_leaders(state) -> jnp.ndarray:
+    """[G] leader peer index per group, -1 if none (mirrors step())."""
+    lead_term = jnp.where(state.role == LEADER, state.term, -1)
+    lead = jnp.argmax(lead_term, axis=1).astype(jnp.int32)
+    active = jnp.take_along_axis(lead_term, lead[:, None], 1)[:, 0] >= 0
+    return jnp.where(active, lead, -1)
+
+
+def counter_submits(G: int) -> Submits:
+    ones = jnp.ones((G, SUBMIT_SLOTS), jnp.int32)
+    return Submits(opcode=ones * ap.OP_LONG_ADD, a=ones, b=ones * 0,
+                   c=ones * 0, tag=ones, valid=ones.astype(bool))
+
+
+def map_submits(G: int) -> Submits:
+    """put/put/get/get over rotating keys (hashed-keyspace kernel)."""
+    ones = jnp.ones((G, SUBMIT_SLOTS), jnp.int32)
+    opc = jnp.asarray([ap.OP_MAP_PUT, ap.OP_MAP_PUT,
+                       ap.OP_MAP_GET, ap.OP_MAP_GET], jnp.int32)
+    keys = jnp.asarray([1, 2, 1, 2], jnp.int32)
+    return Submits(opcode=jnp.broadcast_to(opc, (G, SUBMIT_SLOTS)),
+                   a=jnp.broadcast_to(keys, (G, SUBMIT_SLOTS)),
+                   b=ones * 7, c=ones * 0, tag=ones,
+                   valid=ones.astype(bool))
+
+
+def lock_submits(G: int) -> Submits:
+    """acquire(1) → acquire(2, queued) → release(1) [grants 2] → release(2).
+
+    Every round drives the full grant chain including the event-push path.
+    """
+    ones = jnp.ones((G, SUBMIT_SLOTS), jnp.int32)
+    opc = jnp.asarray([ap.OP_LOCK_ACQUIRE, ap.OP_LOCK_ACQUIRE,
+                       ap.OP_LOCK_RELEASE, ap.OP_LOCK_RELEASE], jnp.int32)
+    who = jnp.asarray([1, 2, 1, 2], jnp.int32)
+    waitflag = jnp.asarray([-1, -1, 0, 0], jnp.int32)
+    return Submits(opcode=jnp.broadcast_to(opc, (G, SUBMIT_SLOTS)),
+                   a=jnp.broadcast_to(who, (G, SUBMIT_SLOTS)),
+                   b=jnp.broadcast_to(waitflag, (G, SUBMIT_SLOTS)),
+                   c=ones * 0, tag=ones, valid=ones.astype(bool))
+
+
+def mixed_submits(G: int) -> Submits:
+    ones = jnp.ones((G, SUBMIT_SLOTS), jnp.int32)
+    opc = jnp.asarray([ap.OP_LONG_ADD, ap.OP_MAP_PUT,
+                       ap.OP_LOCK_ACQUIRE, ap.OP_LOCK_RELEASE], jnp.int32)
+    a = jnp.asarray([1, 3, 9, 9], jnp.int32)
+    b = jnp.asarray([0, 5, -1, 0], jnp.int32)
+    return Submits(opcode=jnp.broadcast_to(opc, (G, SUBMIT_SLOTS)),
+                   a=jnp.broadcast_to(a, (G, SUBMIT_SLOTS)),
+                   b=jnp.broadcast_to(b, (G, SUBMIT_SLOTS)),
+                   c=ones * 0, tag=ones, valid=ones.astype(bool))
+
+
+SUBMIT_BUILDERS = {
+    "counter": counter_submits,
+    "map": map_submits,
+    "lock": lock_submits,
+    "mixed": mixed_submits,
+}
+
+
+def isolation_masks(rounds: int, G: int, P: int, period: int,
+                    seed: int) -> jnp.ndarray:
+    """Per-round victim peer per group (-1 = no fault), [R, G] int32."""
+    rng = np.random.default_rng(seed)
+    victims = np.full((rounds, G), -1, np.int32)
+    for r in range(0, rounds, period):
+        victims[r: r + period // 2] = rng.integers(0, P, G, dtype=np.int32)
+    return jnp.asarray(victims)
+
+
+def victim_deliver(victim: jnp.ndarray, G: int, P: int) -> jnp.ndarray:
+    """deliver[G,P,P] isolating ``victim[G]`` (-1 = fully connected)."""
+    peers = jnp.arange(P)
+    hit = peers[None, :] == victim[:, None]          # [G,P]
+    cut = hit[:, :, None] | hit[:, None, :]
+    return ~cut | (victim[:, None, None] < 0)
+
+
+def elect_all(state, jit_step, empty, deliver, key, G):
+    t0 = time.perf_counter()
+    for r in range(150):
+        key, k = jax.random.split(key)
+        state, out = jit_step(state, empty, deliver, k)
+        if int((np.asarray(out.leader) >= 0).sum()) == G:
+            break
+    else:
+        raise RuntimeError("not all groups elected a leader")
+    log(f"bench: all {G} leaders elected in {r + 1} rounds "
+        f"({time.perf_counter() - t0:.1f}s incl. compile)")
+    return state, key
+
+
+def run_throughput(scenario: str) -> dict:
     config = Config()
     key = jax.random.PRNGKey(0)
     key, init_key = jax.random.split(key)
     state = init_state(GROUPS, PEERS, LOG_SLOTS, init_key, config)
     deliver = full_delivery(GROUPS, PEERS)
-
-    ones = jnp.ones((GROUPS, SUBMIT_SLOTS), jnp.int32)
-    submits = Submits(opcode=ones * OP_LONG_ADD, a=ones, b=ones * 0,
-                      tag=ones, valid=ones.astype(bool))
+    submits = SUBMIT_BUILDERS[scenario](GROUPS)
     jit_step = jax.jit(partial(step, config=config))
 
-    log(f"bench: G={GROUPS} P={PEERS} L={LOG_SLOTS} rounds={ROUNDS} "
-        f"device={jax.devices()[0].platform}")
+    log(f"bench[{scenario}]: G={GROUPS} P={PEERS} L={LOG_SLOTS} "
+        f"rounds={ROUNDS} device={jax.devices()[0].platform}")
+    state, key = elect_all(state, jit_step, empty_submits(GROUPS), deliver,
+                           key, GROUPS)
 
-    # Elect leaders in every group (empty submits).
-    empty = Submits(opcode=ones * 0, a=ones * 0, b=ones * 0, tag=ones * 0,
-                    valid=jnp.zeros((GROUPS, SUBMIT_SLOTS), bool))
-    t0 = time.perf_counter()
-    for r in range(100):
-        key, k = jax.random.split(key)
-        state, out = jit_step(state, empty, deliver, k)
-        if int((np.asarray(out.leader) >= 0).sum()) == GROUPS:
-            break
-    else:
-        raise RuntimeError("not all groups elected a leader")
-    log(f"bench: all {GROUPS} leaders elected in {r + 1} rounds "
-        f"({time.perf_counter() - t0:.1f}s incl. compile)")
+    nemesis = scenario == "mixed"
+    victims = (isolation_masks(ROUNDS, GROUPS, PEERS, period=20, seed=1)
+               if nemesis else None)
 
     def run(state, key):
-        def body(carry, _):
+        def body(carry, victim):
             state, key = carry
             key, k = jax.random.split(key)
-            state, out = step(state, submits, deliver, k, config=config)
+            dl = (victim_deliver(victim, GROUPS, PEERS) if nemesis
+                  else deliver)
+            state, out = step(state, submits, dl, k, config=config)
             return (state, key), out.out_valid.sum(dtype=jnp.int32)
-        (state, key), counts = jax.lax.scan(body, (state, key), None,
-                                            length=ROUNDS)
+        (state, key), counts = jax.lax.scan(body, (state, key), victims,
+                                            length=None if nemesis else ROUNDS)
         return state, key, counts.sum()
 
     run_jit = jax.jit(run)
-
-    # Warmup (compile + reach steady state).
     state, key, n = run_jit(state, key)
     jax.block_until_ready(n)
-    log(f"bench: warmup committed {int(n)} ops")
+    log(f"bench[{scenario}]: warmup committed {int(n)} ops")
 
     best = 0.0
     for rep in range(REPEATS):
@@ -102,15 +202,82 @@ def main() -> None:
         dt = time.perf_counter() - t0
         ops = n / dt
         best = max(best, ops)
-        log(f"bench: rep {rep}: {n} committed ops in {dt:.3f}s -> "
-            f"{ops:,.0f} ops/sec ({dt / ROUNDS * 1e3:.2f} ms/round)")
+        log(f"bench[{scenario}]: rep {rep}: {n} committed ops in {dt:.3f}s "
+            f"-> {ops:,.0f} ops/sec ({dt / ROUNDS * 1e3:.2f} ms/round)")
 
-    print(json.dumps({
-        "metric": f"committed_linearizable_ops_per_sec_{GROUPS}_groups",
+    suffix = "" if scenario == "counter" else f"_{scenario}"
+    return {
+        "metric": (f"committed_linearizable_ops_per_sec_{GROUPS}_groups"
+                   f"{suffix}"),
         "value": round(best, 1),
         "unit": "ops/sec",
         "vs_baseline": round(best / NORTH_STAR_OPS, 4),
-    }))
+    }
+
+
+def run_election() -> dict:
+    """Config #2: forced leader churn; measures elections completed/sec."""
+    config = Config()
+    key = jax.random.PRNGKey(0)
+    key, init_key = jax.random.split(key)
+    state = init_state(GROUPS, PEERS, LOG_SLOTS, init_key, config)
+    deliver = full_delivery(GROUPS, PEERS)
+    empty = empty_submits(GROUPS)
+    jit_step = jax.jit(partial(step, config=config))
+
+    log(f"bench[election]: G={GROUPS} P={PEERS} rounds={ROUNDS} "
+        f"device={jax.devices()[0].platform}")
+    state, key = elect_all(state, jit_step, empty, deliver, key, GROUPS)
+    victims = isolation_masks(ROUNDS, GROUPS, PEERS, period=15, seed=2)
+
+    def run(state, key):
+        def body(carry, victim):
+            state, key, prev = carry
+            key, k = jax.random.split(key)
+            dl = victim_deliver(victim, GROUPS, PEERS)
+            state, out = step(state, empty, dl, k, config=config)
+            changed = ((out.leader >= 0) & (out.leader != prev)).sum(
+                dtype=jnp.int32)
+            return (state, key, out.leader), changed
+        # seed prev with the REAL current leaders so settled groups don't
+        # count as spurious elections in the first round
+        init = (state, key, current_leaders(state))
+        (state, key, _), changes = jax.lax.scan(body, init, victims)
+        return state, key, changes.sum()
+
+    run_jit = jax.jit(run)
+    state, key, n = run_jit(state, key)
+    jax.block_until_ready(n)
+    log(f"bench[election]: warmup saw {int(n)} leader changes")
+
+    best = 0.0
+    for rep in range(REPEATS):
+        t0 = time.perf_counter()
+        state, key, n = run_jit(state, key)
+        n = int(jax.block_until_ready(n))
+        dt = time.perf_counter() - t0
+        rate = n / dt
+        best = max(best, rate)
+        log(f"bench[election]: rep {rep}: {n} elections in {dt:.3f}s "
+            f"-> {rate:,.0f} elections/sec")
+
+    return {
+        "metric": f"elections_per_sec_{GROUPS}_groups_under_nemesis",
+        "value": round(best, 1),
+        "unit": "elections/sec",
+        "vs_baseline": round(best / NORTH_STAR_OPS, 4),
+    }
+
+
+def main() -> None:
+    if SCENARIO == "election":
+        result = run_election()
+    elif SCENARIO in SUBMIT_BUILDERS:
+        result = run_throughput(SCENARIO)
+    else:
+        raise SystemExit(f"unknown scenario {SCENARIO!r}; pick one of "
+                         f"{['election', *SUBMIT_BUILDERS]}")
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
