@@ -1,0 +1,311 @@
+"""Native TCP transport: C++ epoll event loop behind the Transport SPI.
+
+The reference's default transport is Netty — a native-backed event-loop
+(`AtomixClient.java:136-144` loads it reflectively). Here the equivalent
+runtime component is real native code (``native/copycat_native.cpp``): an
+epoll thread owns the sockets and parses the shared wire format
+``[u32 len][u8 kind][u64 corr][payload]`` — byte-identical to
+:mod:`copycat_tpu.io.tcp`, so native and asyncio endpoints interoperate.
+Python only exchanges complete frames with the loop via ctypes
+(no pybind11 in the image; plain C ABI).
+
+``NativeTcpTransport`` is a drop-in for ``TcpTransport``; if the shared
+library can't be built (no toolchain), importing still works and
+``native_available()`` returns False — callers fall back to asyncio TCP.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import pathlib
+import subprocess
+import threading
+from typing import Any, Callable
+
+from .serializer import Serializer
+from .transport import (
+    Address,
+    Client,
+    Connection,
+    ConnectionClosedError,
+    Server,
+    Transport,
+    TransportError,
+)
+
+_REQUEST, _RESPONSE, _ERROR = 0, 1, 2
+_ETYPE_ACCEPT, _ETYPE_FRAME, _ETYPE_CLOSE = 1, 2, 3
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parents[2] / "native"
+_LIB_PATH = _NATIVE_DIR / "libcopycat_native.so"
+
+_lib: ctypes.CDLL | None = None
+_lib_err: str | None = None
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    try:
+        src = _NATIVE_DIR / "copycat_native.cpp"
+        if (not _LIB_PATH.exists()
+                or _LIB_PATH.stat().st_mtime < src.stat().st_mtime):
+            subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True,
+                           capture_output=True, timeout=120)
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib.cn_new.restype = ctypes.c_void_p
+        lib.cn_start.argtypes = [ctypes.c_void_p]
+        lib.cn_listen.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int]
+        lib.cn_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int]
+        lib.cn_send.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                ctypes.c_uint8, ctypes.c_uint64,
+                                ctypes.c_char_p, ctypes.c_int]
+        lib.cn_poll.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_char_p, ctypes.c_int]
+        lib.cn_close_conn.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.cn_shutdown.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    except Exception as exc:  # toolchain missing — degrade gracefully
+        _lib_err = str(exc)
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class _NativeLoop:
+    """Owns one C++ epoll loop + the Python-side poller thread."""
+
+    def __init__(self) -> None:
+        lib = _load()
+        if lib is None:
+            raise TransportError(f"native transport unavailable: {_lib_err}")
+        self._lib = lib
+        self._handle = ctypes.c_void_p(lib.cn_new())
+        if lib.cn_start(self._handle) != 0:
+            raise TransportError("failed to start native loop thread")
+        self._cap = 1 << 20
+        self._buf = ctypes.create_string_buffer(self._cap)
+        self._routes: dict[int, Callable[[int, int, int, bytes], None]] = {}
+        self._accepts: dict[int, Callable[[int], None]] = {}
+        self._aio: asyncio.AbstractEventLoop | None = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._poller, daemon=True,
+                                        name="copycat-native-poll")
+        self._thread.start()
+
+    def bind_asyncio(self, loop: asyncio.AbstractEventLoop) -> None:
+        self._aio = loop
+
+    def _poller(self) -> None:
+        conn = ctypes.c_int()
+        etype = ctypes.c_int()
+        kind = ctypes.c_uint8()
+        corr = ctypes.c_uint64()
+        while not self._stop.is_set():
+            n = self._lib.cn_poll(self._handle, 100, ctypes.byref(conn),
+                                  ctypes.byref(etype), ctypes.byref(kind),
+                                  ctypes.byref(corr), self._buf, self._cap)
+            if n == -1:
+                continue
+            if n == -2:  # grow and re-poll; the event was kept queued
+                self._cap = max(self._cap * 2, int(corr.value) + 1)
+                self._buf = ctypes.create_string_buffer(self._cap)
+                continue
+            payload = self._buf.raw[:n] if n > 0 else b""
+            self._dispatch(conn.value, etype.value, kind.value,
+                           int(corr.value), payload)
+
+    def _dispatch(self, conn: int, etype: int, kind: int, corr: int,
+                  payload: bytes) -> None:
+        aio = self._aio
+        if aio is None or aio.is_closed():
+            return
+        # Route lookups must happen IN the asyncio thread: an ACCEPT's
+        # callback (which registers the route) and the first FRAME arrive
+        # back-to-back from the poller, and call_soon_threadsafe preserves
+        # their order only inside the loop.
+        def deliver() -> None:
+            if etype == _ETYPE_ACCEPT:
+                fn = self._accepts.get(corr)  # corr = listener fd
+                if fn is not None:
+                    fn(conn)
+                return
+            route = self._routes.get(conn)
+            if route is not None:
+                route(etype, kind, corr, payload)
+
+        try:
+            aio.call_soon_threadsafe(deliver)
+        except RuntimeError:  # loop shut down mid-poll
+            pass
+
+    # thin C wrappers -----------------------------------------------------
+    def listen(self, address: Address) -> int:
+        fd = self._lib.cn_listen(self._handle, address.host.encode(),
+                                 address.port)
+        if fd < 0:
+            raise TransportError(f"cannot listen on {address}")
+        return fd
+
+    def connect(self, address: Address) -> int:
+        fd = self._lib.cn_connect(self._handle, address.host.encode(),
+                                  address.port)
+        if fd < 0:
+            raise TransportError(f"cannot connect to {address}")
+        return fd
+
+    def send(self, conn: int, kind: int, corr: int, payload: bytes) -> None:
+        if self._lib.cn_send(self._handle, conn, kind, corr, payload,
+                             len(payload)) != 0:
+            raise ConnectionClosedError("connection closed")
+
+    def close_conn(self, conn: int) -> None:
+        self._lib.cn_close_conn(self._handle, conn)
+
+    def shutdown(self) -> None:
+        if not self._stop.is_set():
+            self._stop.set()
+            self._thread.join(timeout=2)
+            self._lib.cn_shutdown(self._handle)
+
+
+class NativeConnection(Connection):
+    """Frame-level I/O lives in C++; request/response correlation here."""
+
+    def __init__(self, loop: _NativeLoop, fd: int,
+                 serializer: Serializer) -> None:
+        super().__init__()
+        self._loop = loop
+        self._fd = fd
+        self._serializer = serializer
+        self._next_id = 0
+        self._pending: dict[int, asyncio.Future] = {}
+        loop._routes[fd] = self._on_event
+
+    def _on_event(self, etype: int, kind: int, corr: int,
+                  payload: bytes) -> None:
+        if etype == _ETYPE_CLOSE:
+            self._abort()
+            return
+        if kind == _REQUEST:
+            asyncio.get_running_loop().create_task(self._serve(corr, payload))
+            return
+        future = self._pending.pop(corr, None)
+        if future is not None and not future.done():
+            if kind == _ERROR:
+                future.set_exception(
+                    TransportError(self._serializer.read(payload)))
+            else:
+                future.set_result(self._serializer.read(payload))
+
+    async def _serve(self, corr: int, payload: bytes) -> None:
+        try:
+            result = await self._handle(self._serializer.read(payload))
+            self._loop.send(self._fd, _RESPONSE, corr,
+                            self._serializer.write(result))
+        except Exception as exc:
+            try:
+                self._loop.send(self._fd, _ERROR, corr, self._serializer.write(
+                    f"{type(exc).__name__}: {exc}"))
+            except Exception:
+                pass
+
+    async def send(self, message: Any) -> Any:
+        if self.closed:
+            raise ConnectionClosedError("connection closed")
+        self._next_id += 1
+        corr = self._next_id
+        future: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[corr] = future
+        self._loop.send(self._fd, _REQUEST, corr,
+                        self._serializer.write(message))
+        return await future
+
+    def _abort(self) -> None:
+        self._loop._routes.pop(self._fd, None)
+        for future in self._pending.values():
+            if not future.done():
+                future.set_exception(ConnectionClosedError("connection closed"))
+        self._pending.clear()
+        self._fire_close()
+
+    async def close(self) -> None:
+        if not self.closed:
+            self._loop.close_conn(self._fd)
+            self._abort()
+
+
+class NativeTcpClient(Client):
+    def __init__(self, loop: _NativeLoop) -> None:
+        self._loop = loop
+        self._connections: list[NativeConnection] = []
+
+    async def connect(self, address: Address) -> Connection:
+        self._loop.bind_asyncio(asyncio.get_running_loop())
+        fd = self._loop.connect(address)
+        conn = NativeConnection(self._loop, fd, Serializer())
+        self._connections.append(conn)
+        conn.on_close(lambda c: self._connections.remove(c)
+                      if c in self._connections else None)
+        return conn
+
+    async def close(self) -> None:
+        for conn in list(self._connections):
+            await conn.close()
+        self._connections.clear()
+
+
+class NativeTcpServer(Server):
+    def __init__(self, loop: _NativeLoop) -> None:
+        self._loop = loop
+        self._listener: int | None = None
+        self._connections: list[NativeConnection] = []
+
+    async def listen(self, address: Address,
+                     on_connect: Callable[[Connection], None]) -> None:
+        self._loop.bind_asyncio(asyncio.get_running_loop())
+        self._listener = self._loop.listen(address)
+
+        def accept(fd: int) -> None:
+            conn = NativeConnection(self._loop, fd, Serializer())
+            self._connections.append(conn)
+            conn.on_close(lambda c: self._connections.remove(c)
+                          if c in self._connections else None)
+            on_connect(conn)
+
+        self._loop._accepts[self._listener] = accept
+
+    async def close(self) -> None:
+        for conn in list(self._connections):
+            await conn.close()
+        self._connections.clear()
+        if self._listener is not None:
+            self._loop._accepts.pop(self._listener, None)
+            self._loop.close_conn(self._listener)
+            self._listener = None
+
+
+class NativeTcpTransport(Transport):
+    """Drop-in for ``TcpTransport`` with the I/O path in C++."""
+
+    def __init__(self) -> None:
+        self._loop = _NativeLoop()
+
+    def client(self) -> Client:
+        return NativeTcpClient(self._loop)
+
+    def server(self) -> Server:
+        return NativeTcpServer(self._loop)
+
+    def shutdown(self) -> None:
+        """Stop the epoll thread (call when done with the transport)."""
+        self._loop.shutdown()

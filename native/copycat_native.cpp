@@ -1,0 +1,385 @@
+// Native I/O substrate: epoll event loop + frame codec.
+//
+// Fills the reference's NettyTransport role (SURVEY.md §5.8, L0 I/O
+// substrate) as real native runtime code: one epoll thread owns all
+// sockets, parses the shared wire format
+//     [u32 length][u8 kind][u64 correlation id][payload]
+// (identical to copycat_tpu/io/tcp.py, so native and asyncio endpoints
+// interoperate), and hands complete frames to Python through a
+// mutex+condvar event queue polled via cn_poll. Sends are enqueued from
+// any thread and flushed by the loop (eventfd wakeup).
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in the image).
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <map>
+#include <mutex>
+#include <condition_variable>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <pthread.h>
+#include <string>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr int ETYPE_ACCEPT = 1;
+constexpr int ETYPE_FRAME = 2;
+constexpr int ETYPE_CLOSE = 3;
+constexpr size_t HEADER = 4 + 1 + 8;
+constexpr size_t MAX_FRAME = 64 * 1024 * 1024;
+
+struct Event {
+  int conn;
+  int etype;
+  uint8_t kind;
+  uint64_t corr;
+  std::vector<uint8_t> payload;
+};
+
+struct Conn {
+  int fd = -1;
+  bool listener = false;
+  std::vector<uint8_t> rbuf;
+  std::deque<std::vector<uint8_t>> wq;  // pending encoded frames
+  size_t wq_off = 0;                    // offset into wq.front()
+};
+
+struct Loop {
+  int epfd = -1;
+  int wakefd = -1;
+  pthread_t thread{};
+  bool running = false;
+
+  std::mutex mu;                 // guards conns / cmds
+  std::map<int, Conn> conns;     // fd -> state
+  std::deque<std::pair<int, std::vector<uint8_t>>> cmds;  // (fd, frame)
+  std::deque<int> closing;
+
+  std::mutex evmu;
+  std::condition_variable evcv;
+  std::deque<Event> events;
+
+  void push_event(Event&& e) {
+    {
+      std::lock_guard<std::mutex> g(evmu);
+      events.push_back(std::move(e));
+    }
+    evcv.notify_one();
+  }
+  void wake() const {
+    uint64_t one = 1;
+    ssize_t r = write(wakefd, &one, sizeof(one));
+    (void)r;
+  }
+};
+
+void set_nonblock(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+void epoll_update(Loop* l, int fd, bool want_write) {
+  epoll_event ev{};
+  ev.events = EPOLLIN | (want_write ? EPOLLOUT : 0u);
+  ev.data.fd = fd;
+  epoll_ctl(l->epfd, EPOLL_CTL_MOD, fd, &ev);
+}
+
+void close_conn_locked(Loop* l, int fd, bool emit) {
+  auto it = l->conns.find(fd);
+  if (it == l->conns.end()) return;
+  epoll_ctl(l->epfd, EPOLL_CTL_DEL, fd, nullptr);
+  close(fd);
+  bool listener = it->second.listener;
+  l->conns.erase(it);
+  if (emit && !listener)
+    l->push_event(Event{fd, ETYPE_CLOSE, 0, 0, {}});
+}
+
+// parse complete frames out of c->rbuf
+void drain_frames(Loop* l, Conn* c) {
+  size_t off = 0;
+  while (c->rbuf.size() - off >= HEADER) {
+    const uint8_t* p = c->rbuf.data() + off;
+    uint32_t len = (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+                   (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+    if (len > MAX_FRAME) {  // poisoned stream: drop the connection
+      close_conn_locked(l, c->fd, true);
+      return;
+    }
+    if (c->rbuf.size() - off < HEADER + len) break;
+    uint8_t kind = p[4];
+    uint64_t corr = 0;
+    for (int i = 0; i < 8; i++) corr = (corr << 8) | p[5 + i];
+    Event e{c->fd, ETYPE_FRAME, kind, corr, {}};
+    e.payload.assign(p + HEADER, p + HEADER + len);
+    l->push_event(std::move(e));
+    off += HEADER + len;
+  }
+  if (off > 0) c->rbuf.erase(c->rbuf.begin(), c->rbuf.begin() + off);
+}
+
+void handle_readable(Loop* l, int fd) {
+  auto it = l->conns.find(fd);
+  if (it == l->conns.end()) return;
+  Conn& c = it->second;
+  if (c.listener) {
+    for (;;) {
+      int cfd = accept(fd, nullptr, nullptr);
+      if (cfd < 0) break;
+      set_nonblock(cfd);
+      int one = 1;
+      setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      Conn nc;
+      nc.fd = cfd;
+      l->conns.emplace(cfd, std::move(nc));
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.fd = cfd;
+      epoll_ctl(l->epfd, EPOLL_CTL_ADD, cfd, &ev);
+      // corr carries the listener fd so Python can route the accept
+      l->push_event(Event{cfd, ETYPE_ACCEPT, 0, uint64_t(fd), {}});
+    }
+    return;
+  }
+  char buf[65536];
+  for (;;) {
+    ssize_t n = recv(fd, buf, sizeof(buf), 0);
+    if (n > 0) {
+      c.rbuf.insert(c.rbuf.end(), buf, buf + n);
+      if (c.rbuf.size() >= HEADER) drain_frames(l, &c);
+      if (l->conns.find(fd) == l->conns.end()) return;  // dropped mid-parse
+    } else if (n == 0) {
+      close_conn_locked(l, fd, true);
+      return;
+    } else {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      close_conn_locked(l, fd, true);
+      return;
+    }
+  }
+}
+
+void handle_writable(Loop* l, int fd) {
+  auto it = l->conns.find(fd);
+  if (it == l->conns.end()) return;
+  Conn& c = it->second;
+  while (!c.wq.empty()) {
+    auto& front = c.wq.front();
+    ssize_t n = send(fd, front.data() + c.wq_off, front.size() - c.wq_off,
+                     MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+      if (errno == EINTR) continue;
+      close_conn_locked(l, fd, true);
+      return;
+    }
+    c.wq_off += size_t(n);
+    if (c.wq_off == front.size()) {
+      c.wq.pop_front();
+      c.wq_off = 0;
+    }
+  }
+  epoll_update(l, fd, false);
+}
+
+void* loop_main(void* arg) {
+  Loop* l = static_cast<Loop*>(arg);
+  epoll_event evs[128];
+  while (l->running) {
+    int n = epoll_wait(l->epfd, evs, 128, 200);
+    std::lock_guard<std::mutex> g(l->mu);
+    for (int i = 0; i < n; i++) {
+      int fd = evs[i].data.fd;
+      if (fd == l->wakefd) {
+        uint64_t tmp;
+        ssize_t r = read(l->wakefd, &tmp, sizeof(tmp));
+        (void)r;
+        continue;
+      }
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        close_conn_locked(l, fd, true);
+        continue;
+      }
+      if (evs[i].events & EPOLLIN) handle_readable(l, fd);
+      if (evs[i].events & EPOLLOUT) handle_writable(l, fd);
+    }
+    // drain queued sends and closes from other threads
+    while (!l->cmds.empty()) {
+      auto [fd, frame] = std::move(l->cmds.front());
+      l->cmds.pop_front();
+      auto it = l->conns.find(fd);
+      if (it == l->conns.end()) continue;
+      it->second.wq.push_back(std::move(frame));
+      epoll_update(l, fd, true);
+      handle_writable(l, fd);
+    }
+    while (!l->closing.empty()) {
+      int fd = l->closing.front();
+      l->closing.pop_front();
+      close_conn_locked(l, fd, false);
+    }
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* cn_new() {
+  Loop* l = new Loop();
+  l->epfd = epoll_create1(0);
+  l->wakefd = eventfd(0, EFD_NONBLOCK);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = l->wakefd;
+  epoll_ctl(l->epfd, EPOLL_CTL_ADD, l->wakefd, &ev);
+  return l;
+}
+
+int cn_start(void* h) {
+  Loop* l = static_cast<Loop*>(h);
+  l->running = true;
+  return pthread_create(&l->thread, nullptr, loop_main, l) == 0 ? 0 : -1;
+}
+
+int cn_listen(void* h, const char* host, int port) {
+  Loop* l = static_cast<Loop*>(h);
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  addr.sin_addr.s_addr =
+      host && *host ? inet_addr(host) : htonl(INADDR_ANY);
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      listen(fd, 128) < 0) {
+    close(fd);
+    return -1;
+  }
+  set_nonblock(fd);
+  std::lock_guard<std::mutex> g(l->mu);
+  Conn c;
+  c.fd = fd;
+  c.listener = true;
+  l->conns.emplace(fd, std::move(c));
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = fd;
+  epoll_ctl(l->epfd, EPOLL_CTL_ADD, fd, &ev);
+  return fd;
+}
+
+int cn_connect(void* h, const char* host, int port) {
+  Loop* l = static_cast<Loop*>(h);
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  addr.sin_addr.s_addr = inet_addr(host && *host ? host : "127.0.0.1");
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    close(fd);
+    return -1;
+  }
+  set_nonblock(fd);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::lock_guard<std::mutex> g(l->mu);
+  Conn c;
+  c.fd = fd;
+  l->conns.emplace(fd, std::move(c));
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = fd;
+  epoll_ctl(l->epfd, EPOLL_CTL_ADD, fd, &ev);
+  return fd;
+}
+
+int cn_send(void* h, int conn, uint8_t kind, uint64_t corr,
+            const uint8_t* data, int len) {
+  Loop* l = static_cast<Loop*>(h);
+  std::vector<uint8_t> frame(HEADER + size_t(len));
+  frame[0] = uint8_t(len >> 24);
+  frame[1] = uint8_t(len >> 16);
+  frame[2] = uint8_t(len >> 8);
+  frame[3] = uint8_t(len);
+  frame[4] = kind;
+  for (int i = 0; i < 8; i++)
+    frame[5 + i] = uint8_t(corr >> (8 * (7 - i)));
+  if (len > 0) memcpy(frame.data() + HEADER, data, size_t(len));
+  {
+    std::lock_guard<std::mutex> g(l->mu);
+    if (l->conns.find(conn) == l->conns.end()) return -1;
+    l->cmds.emplace_back(conn, std::move(frame));
+  }
+  l->wake();
+  return 0;
+}
+
+// Returns payload length (>=0) with out params filled, -1 on timeout.
+int cn_poll(void* h, int timeout_ms, int* conn, int* etype, uint8_t* kind,
+            uint64_t* corr, uint8_t* buf, int cap) {
+  Loop* l = static_cast<Loop*>(h);
+  std::unique_lock<std::mutex> g(l->evmu);
+  if (l->events.empty()) {
+    l->evcv.wait_for(g, std::chrono::milliseconds(timeout_ms),
+                     [l] { return !l->events.empty(); });
+  }
+  if (l->events.empty()) return -1;
+  int n = int(l->events.front().payload.size());
+  if (n > cap) {  // caller must re-poll with a bigger buffer; keep event
+    *conn = l->events.front().conn;
+    *etype = 0;
+    *kind = 0;
+    *corr = uint64_t(n);
+    return -2;
+  }
+  Event e = std::move(l->events.front());
+  l->events.pop_front();
+  g.unlock();
+  *conn = e.conn;
+  *etype = e.etype;
+  *kind = e.kind;
+  *corr = e.corr;
+  if (n > 0) memcpy(buf, e.payload.data(), size_t(n));
+  return n;
+}
+
+int cn_close_conn(void* h, int conn) {
+  Loop* l = static_cast<Loop*>(h);
+  {
+    std::lock_guard<std::mutex> g(l->mu);
+    l->closing.push_back(conn);
+  }
+  l->wake();
+  return 0;
+}
+
+void cn_shutdown(void* h) {
+  Loop* l = static_cast<Loop*>(h);
+  l->running = false;
+  l->wake();
+  pthread_join(l->thread, nullptr);
+  for (auto& [fd, c] : l->conns) close(fd);
+  close(l->epfd);
+  close(l->wakefd);
+  delete l;
+}
+
+}  // extern "C"

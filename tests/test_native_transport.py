@@ -1,0 +1,121 @@
+"""Native C++ transport tests (native/copycat_native.cpp via io/native.py).
+
+Skipped when the toolchain can't build the shared library. The wire format
+is shared with the asyncio TCP transport, so the interop test runs a native
+server against an asyncio client.
+"""
+
+import asyncio
+
+import pytest
+
+from copycat_tpu.io.native import native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native library unavailable")
+
+from copycat_tpu.io.native import NativeTcpTransport  # noqa: E402
+from copycat_tpu.io.tcp import TcpTransport  # noqa: E402
+from copycat_tpu.io.transport import Address, TransportError  # noqa: E402
+
+PORT = 18431
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+def echo_handler(conn):
+    async def echo(msg):
+        return f"echo:{msg}"
+    conn.handler(str, echo)
+
+
+def test_native_request_response():
+    async def main():
+        transport = NativeTcpTransport()
+        try:
+            server = transport.server()
+            await server.listen(Address("127.0.0.1", PORT), echo_handler)
+            conn = await transport.client().connect(Address("127.0.0.1", PORT))
+            assert await conn.send("hello") == "echo:hello"
+            big = "x" * 2_000_000  # exceeds the initial 1MB poll buffer
+            assert await conn.send(big) == f"echo:{big}"
+            await conn.close()
+            await server.close()
+        finally:
+            transport.shutdown()
+    run(main())
+
+
+def test_native_concurrent_requests():
+    async def main():
+        transport = NativeTcpTransport()
+        try:
+            server = transport.server()
+            await server.listen(Address("127.0.0.1", PORT + 1), echo_handler)
+            conn = await transport.client().connect(
+                Address("127.0.0.1", PORT + 1))
+            results = await asyncio.gather(
+                *[conn.send(f"m{i}") for i in range(50)])
+            assert results == [f"echo:m{i}" for i in range(50)]
+            await conn.close()
+            await server.close()
+        finally:
+            transport.shutdown()
+    run(main())
+
+
+def test_native_handler_error_crosses_wire():
+    async def main():
+        transport = NativeTcpTransport()
+        try:
+            server = transport.server()
+
+            def attach(conn):
+                async def boom(msg):
+                    raise ValueError("nope")
+                conn.handler(str, boom)
+
+            await server.listen(Address("127.0.0.1", PORT + 2), attach)
+            conn = await transport.client().connect(
+                Address("127.0.0.1", PORT + 2))
+            with pytest.raises(TransportError, match="ValueError: nope"):
+                await conn.send("x")
+            await conn.close()
+            await server.close()
+        finally:
+            transport.shutdown()
+    run(main())
+
+
+def test_native_server_asyncio_client_interop():
+    """Same wire format as io/tcp.py: endpoints interoperate."""
+    async def main():
+        native = NativeTcpTransport()
+        try:
+            server = native.server()
+            await server.listen(Address("127.0.0.1", PORT + 3), echo_handler)
+            conn = await TcpTransport().client().connect(
+                Address("127.0.0.1", PORT + 3))
+            assert await conn.send("across") == "echo:across"
+            await conn.close()
+            await server.close()
+        finally:
+            native.shutdown()
+    run(main())
+
+
+def test_asyncio_server_native_client_interop():
+    async def main():
+        native = NativeTcpTransport()
+        try:
+            server = TcpTransport().server()
+            await server.listen(Address("127.0.0.1", PORT + 4), echo_handler)
+            conn = await native.client().connect(Address("127.0.0.1", PORT + 4))
+            assert await conn.send("back") == "echo:back"
+            await conn.close()
+            await server.close()
+        finally:
+            native.shutdown()
+    run(main())
