@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from copycat_tpu.ops import apply as ap
+from copycat_tpu.ops.apply import ResourceConfig
 from copycat_tpu.ops.consensus import (
     Config,
     LEADER,
@@ -46,15 +47,29 @@ from copycat_tpu.ops.consensus import (
     step,
 )
 
+# Pool state is carried through every step (HBM traffic), so each scenario
+# compiles in only the pools its groups actually host (ResourceConfig
+# zero-size pools are compiled out of the kernel).
+RESOURCE_CONFIGS = {
+    "counter": ResourceConfig.counters_only(),
+    "election": ResourceConfig.counters_only(),
+    "map": ResourceConfig(set_slots=0, queue_slots=0, wait_slots=0,
+                          listener_slots=0, event_slots=0),
+    "lock": ResourceConfig(map_slots=0, set_slots=0, queue_slots=0,
+                           listener_slots=0),
+    "mixed": ResourceConfig(set_slots=0, queue_slots=0, listener_slots=0),
+}
+
 SCENARIO = os.environ.get("COPYCAT_BENCH_SCENARIO", "counter")
 GROUPS = int(os.environ.get(
     "COPYCAT_BENCH_GROUPS", "1000" if SCENARIO == "election" else "10000"))
 PEERS = int(os.environ.get("COPYCAT_BENCH_PEERS", "3"))
-LOG_SLOTS = int(os.environ.get("COPYCAT_BENCH_LOG_SLOTS", "32"))
+LOG_SLOTS = int(os.environ.get("COPYCAT_BENCH_LOG_SLOTS", "64"))
 ROUNDS = int(os.environ.get("COPYCAT_BENCH_ROUNDS", "200"))
 REPEATS = int(os.environ.get("COPYCAT_BENCH_REPEATS", "3"))
-SUBMIT_SLOTS = 4
+SUBMIT_SLOTS = int(os.environ.get("COPYCAT_BENCH_SUBMIT_SLOTS", "16"))
 NORTH_STAR_OPS = 1_000_000.0
+USE_PALLAS = os.environ.get("COPYCAT_BENCH_PALLAS", "0") == "1"
 
 
 def log(msg: str) -> None:
@@ -160,7 +175,10 @@ def elect_all(state, jit_step, empty, deliver, key, G):
 
 
 def run_throughput(scenario: str) -> dict:
-    config = Config()
+    config = Config(use_pallas=USE_PALLAS,
+                    append_window=max(4, SUBMIT_SLOTS),
+                    applies_per_round=max(4, SUBMIT_SLOTS),
+                    resource=RESOURCE_CONFIGS.get(scenario, ResourceConfig()))
     key = jax.random.PRNGKey(0)
     key, init_key = jax.random.split(key)
     state = init_state(GROUPS, PEERS, LOG_SLOTS, init_key, config)
@@ -217,7 +235,8 @@ def run_throughput(scenario: str) -> dict:
 
 def run_election() -> dict:
     """Config #2: forced leader churn; measures elections completed/sec."""
-    config = Config()
+    config = Config(use_pallas=USE_PALLAS,
+                    resource=RESOURCE_CONFIGS["election"])
     key = jax.random.PRNGKey(0)
     key, init_key = jax.random.split(key)
     state = init_state(GROUPS, PEERS, LOG_SLOTS, init_key, config)

@@ -14,6 +14,12 @@ Design rules (SURVEY.md §7.3):
 - **Fixed shapes**: maps/sets are fixed-slot probe tables, queues and wait
   lists are fixed-capacity rings. Overflow returns the ``FAIL`` sentinel —
   the host falls back to the CPU oracle path for oversized resources.
+- **Pay only for hosted types**: every pool size in :class:`ResourceConfig`
+  may be 0, which compiles the pool *out* of the kernel entirely (its ops
+  then return ``FAIL``). A deployment whose groups host only counters
+  carries no map/lock/event state through the step — pool traffic is the
+  step's bandwidth bill, so this is the single biggest throughput lever
+  (measured 600k → 1.6M committed ops/sec at 10k groups on one chip).
 - **Deterministic time** (§7.3 #3): TTLs and lock timeouts are evaluated
   lazily against the *entry's* logical timestamp (the leader's replicated
   round clock at append), never wall clock — replica state stays a pure
@@ -25,7 +31,9 @@ Design rules (SURVEY.md §7.3):
   ``LockState.java:publish("lock",…)``, election ``publish("elect",…)``)
   go into a per-lane replicated event ring with absolute sequence numbers;
   the step drains the leader lane into ``StepOutputs`` and the host dedups
-  by sequence across leader changes (at-least-once across failover).
+  by sequence across leader changes (at-least-once while a leader exists,
+  with the authoritative ``OP_LOCK_HOLDER``/``OP_ELECT_LEADER`` queries as
+  the overflow-proof fallback).
 
 Only fixed-width state lives on device. Arbitrary Python payloads take the
 CPU oracle path (``copycat_tpu.server``); the device path covers the hot,
@@ -110,14 +118,26 @@ EV_ELECT = 3        # target=new leader id, arg=epoch (fencing token)
 
 
 class ResourceConfig(NamedTuple):
-    """Fixed device pool sizes (hashable — part of the jit-static Config)."""
+    """Fixed device pool sizes (hashable — part of the jit-static Config).
+
+    Any size may be 0: the pool is then compiled out of the kernel and its
+    ops return ``FAIL``. Size the pools to the resource types the groups
+    actually host — pool state is carried through every step, so unused
+    pools cost real HBM bandwidth.
+    """
 
     map_slots: int = 16
     set_slots: int = 16
     queue_slots: int = 16
-    wait_slots: int = 8       # lock wait queue
-    listener_slots: int = 8   # election listener queue
+    wait_slots: int = 8       # lock wait queue (0 = try-lock only)
+    listener_slots: int = 8   # election listener queue (0 = no succession)
     event_slots: int = 32     # session-event outbox ring
+
+    @classmethod
+    def counters_only(cls) -> "ResourceConfig":
+        """Value/long registers only — the leanest (fastest) kernel."""
+        return cls(map_slots=0, set_slots=0, queue_slots=0, wait_slots=0,
+                   listener_slots=0, event_slots=0)
 
 
 class ResourceState(NamedTuple):
@@ -128,7 +148,9 @@ class ResourceState(NamedTuple):
     exactly the reference's replicated-state-machine discipline, kept as a
     batch dimension so divergence is *testable* (see tests). The event ring
     (``ev_*``) is outbox infrastructure, not linearizable state: lanes drain
-    it in lockstep, so its heads may differ across replicas.
+    it in lockstep, so its heads may differ across replicas. Disabled pools
+    (size 0) are zero-width arrays — present in the tree, absent from the
+    compiled program.
     """
 
     # value register + TTL deadline (0 = none)
@@ -232,6 +254,25 @@ def _ring_pos(head: jnp.ndarray, n: int) -> jnp.ndarray:
     return (slots - head[..., None]) % n
 
 
+def _ring_compact(mask: jnp.ndarray, head, size, pos, live_arr, live_win,
+                  *arrays):
+    """Stable-compact ring slots where ``mask`` holds; returns
+    (head, size, live, compacted arrays...). FIFO order of live entries is
+    preserved (argsort key = pos for live, pos+N for dead). Lanes where
+    ``mask`` is False keep every field untouched."""
+    N = arrays[0].shape[-1]
+    order = jnp.argsort(jnp.where(live_win, pos, N + pos), axis=-1)
+    count = jnp.sum(live_win, axis=-1).astype(jnp.int32)
+    m3 = mask[..., None]
+    out = [jnp.where(m3, jnp.take_along_axis(arr, order, axis=-1), arr)
+           for arr in arrays]
+    live = jnp.where(m3, jnp.arange(N)[None, None, :] < count[..., None],
+                     live_arr)
+    head = jnp.where(mask, 0, head)
+    size = jnp.where(mask, count, size)
+    return head, size, live, out
+
+
 # ---------------------------------------------------------------------------
 # the apply kernel
 # ---------------------------------------------------------------------------
@@ -252,24 +293,28 @@ def apply_entry(
     response for the lane (meaningful only where ``live``). Session events
     are pushed into the state's event ring.
     """
-    # exactly one event per applied entry (grant/fail/elect are mutually
+    # exactly one event per applied entry (grant/elect are mutually
     # exclusive across opcodes), accumulated and pushed once at the end
     ev_mask = jnp.zeros_like(live)
     ev_code = jnp.zeros_like(opcode)
     ev_target = jnp.zeros_like(opcode)
     ev_arg = jnp.zeros_like(opcode)
     result = jnp.zeros_like(opcode)
+    updates: dict = {}
 
-    # ---- value / long -----------------------------------------------------
+    def op(code):
+        return live & (opcode == code)
+
+    # ---- value / long (always compiled in — two [G,P] planes) -------------
     value, val_dl = res.value, res.val_dl
     expired = (val_dl > 0) & (val_dl <= now)
     eff = jnp.where(expired, 0, value)  # TTL'd value reads as unset
 
-    is_set = live & (opcode == OP_VALUE_SET)
-    is_get = live & (opcode == OP_VALUE_GET)
-    is_cas = live & (opcode == OP_VALUE_CAS)
-    is_gas = live & (opcode == OP_VALUE_GET_AND_SET)
-    is_add = live & (opcode == OP_LONG_ADD)
+    is_set = op(OP_VALUE_SET)
+    is_get = op(OP_VALUE_GET)
+    is_cas = op(OP_VALUE_CAS)
+    is_gas = op(OP_VALUE_GET_AND_SET)
+    is_add = op(OP_LONG_ADD)
     cas_hit = is_cas & (eff == a)
     # Only ops that actually write may touch value/val_dl — a failed CAS
     # must leave an active TTL intact.
@@ -281,9 +326,9 @@ def apply_entry(
     new_value = jnp.where(cas_hit, b, new_value)
     new_value = jnp.where(is_gas, a, new_value)
     new_value = jnp.where(is_add, eff + a, new_value)
-    value = jnp.where(wrote, new_value, jnp.where(purge, 0, value))
+    updates["value"] = jnp.where(wrote, new_value, jnp.where(purge, 0, value))
     new_dl = jnp.where(is_set & (c > 0), now + c, 0)
-    val_dl = jnp.where(wrote, new_dl, jnp.where(purge, 0, val_dl))
+    updates["val_dl"] = jnp.where(wrote, new_dl, jnp.where(purge, 0, val_dl))
 
     result = jnp.where(is_get, eff, result)
     result = jnp.where(is_cas, cas_hit.astype(jnp.int32), result)
@@ -291,290 +336,283 @@ def apply_entry(
     result = jnp.where(is_add, eff + a, result)
 
     # ---- map --------------------------------------------------------------
-    mk, mv, ml, mdl = res.map_key, res.map_val, res.map_live, res.map_dl
-    m_alive = ml & ((mdl == 0) | (mdl > now[..., None]))
-    m_free = ~m_alive
     is_map = live & (opcode >= OP_MAP_PUT) & (opcode <= OP_MAP_CLEAR)
-    hit = m_alive & (mk == a[..., None])
-    hit_idx, hit_any = _first_true(hit)
-    free_idx, free_any = _first_true(m_free)
-    old = jnp.where(hit_any, _gather3(mv, hit_idx), 0)
+    if res.map_key.shape[-1] > 0:
+        mk, mv, ml, mdl = res.map_key, res.map_val, res.map_live, res.map_dl
+        m_alive = ml & ((mdl == 0) | (mdl > now[..., None]))
+        hit = m_alive & (mk == a[..., None])
+        hit_idx, hit_any = _first_true(hit)
+        free_idx, free_any = _first_true(~m_alive)
+        old = jnp.where(hit_any, _gather3(mv, hit_idx), 0)
 
-    def mop(code):
-        return live & (opcode == code)
+        put = op(OP_MAP_PUT)
+        pia = op(OP_MAP_PUT_IF_ABSENT)
+        rep = op(OP_MAP_REPLACE)
+        repif = op(OP_MAP_REPLACE_IF) & hit_any & (old == b)
+        write_new = (put | pia) & ~hit_any           # needs a free slot
+        write_over = (put & hit_any) | (rep & hit_any) | repif
+        ins_ok = write_new & free_any
+        w_idx = jnp.where(hit_any, hit_idx, free_idx)
+        w_val = jnp.where(repif, c, b)
+        w_dl = jnp.where((put | pia) & (c > 0), now + c, 0)
+        do_write = ins_ok | write_over
+        mk = _scatter3(mk, w_idx, do_write, a)
+        mv = _scatter3(mv, w_idx, do_write, w_val)
+        mdl = _scatter3(mdl, w_idx, do_write,
+                        jnp.where(write_over & ~put, 0, w_dl))
+        ml = _scatter3(ml, w_idx, do_write, jnp.ones_like(a, bool))
 
-    put = mop(OP_MAP_PUT)
-    pia = mop(OP_MAP_PUT_IF_ABSENT)
-    rep = mop(OP_MAP_REPLACE)
-    repif = mop(OP_MAP_REPLACE_IF) & hit_any & (old == b)
-    write_new = (put | pia) & ~hit_any           # needs a free slot
-    write_over = (put & hit_any) | rep & hit_any | repif
-    ins_ok = write_new & free_any
-    w_idx = jnp.where(hit_any, hit_idx, free_idx)
-    w_val = jnp.where(repif, c, b)
-    w_dl = jnp.where((put | pia) & (c > 0), now + c, 0)
-    do_write = ins_ok | write_over
-    mk = _scatter3(mk, w_idx, do_write, a)
-    mv = _scatter3(mv, w_idx, do_write, w_val)
-    mdl = _scatter3(mdl, w_idx, do_write, jnp.where(write_over & ~put, 0, w_dl))
-    ml = _scatter3(ml, w_idx, do_write, jnp.ones_like(a, bool))
+        rm = op(OP_MAP_REMOVE) | (op(OP_MAP_REMOVE_IF) & (old == b))
+        ml = _scatter3(ml, hit_idx, rm & hit_any, jnp.zeros_like(a, bool))
+        ml = jnp.where(op(OP_MAP_CLEAR)[..., None], False, ml)
+        # drop expired slots whenever any map op touches the group (lazy
+        # purge; just-written slots have dl == 0 or dl > now, so they
+        # always survive)
+        ml = jnp.where(is_map[..., None],
+                       ml & ((mdl == 0) | (mdl > now[..., None])), ml)
+        updates.update(map_key=mk, map_val=mv, map_live=ml, map_dl=mdl)
 
-    rm = mop(OP_MAP_REMOVE) | (mop(OP_MAP_REMOVE_IF) & (old == b))
-    ml = _scatter3(ml, hit_idx, rm & hit_any, jnp.zeros_like(a, bool))
-    clear = mop(OP_MAP_CLEAR)
-    ml = jnp.where(clear[..., None], False, ml)
-    # drop expired slots whenever any map op touches the group (lazy purge;
-    # just-written slots have dl == 0 or dl > now, so they always survive)
-    ml = jnp.where(is_map[..., None],
-                   ml & ((mdl == 0) | (mdl > now[..., None])), ml)
-
-    m_size = jnp.sum(m_alive, axis=-1).astype(jnp.int32)
-    result = jnp.where(put, old, result)
-    result = jnp.where(put & write_new & ~free_any, INT_MIN, result)
-    result = jnp.where(pia, jnp.where(hit_any, 0,
-                       jnp.where(free_any, 1, INT_MIN)), result)
-    result = jnp.where(mop(OP_MAP_GET), old, result)
-    result = jnp.where(mop(OP_MAP_GET_OR_DEFAULT),
-                       jnp.where(hit_any, old, b), result)
-    result = jnp.where(mop(OP_MAP_REMOVE), old, result)
-    result = jnp.where(mop(OP_MAP_REMOVE_IF),
-                       (hit_any & (old == b)).astype(jnp.int32), result)
-    result = jnp.where(rep, jnp.where(hit_any, old, INT_MIN), result)
-    result = jnp.where(mop(OP_MAP_REPLACE_IF), repif.astype(jnp.int32), result)
-    result = jnp.where(mop(OP_MAP_CONTAINS_KEY), hit_any.astype(jnp.int32),
-                       result)
-    result = jnp.where(mop(OP_MAP_CONTAINS_VALUE),
-                       jnp.any(m_alive & (mv == a[..., None]),
-                               axis=-1).astype(jnp.int32), result)
-    result = jnp.where(mop(OP_MAP_SIZE), m_size, result)
-    result = jnp.where(mop(OP_MAP_IS_EMPTY), (m_size == 0).astype(jnp.int32),
-                       result)
+        m_size = jnp.sum(m_alive, axis=-1).astype(jnp.int32)
+        result = jnp.where(put, old, result)
+        result = jnp.where(put & write_new & ~free_any, INT_MIN, result)
+        result = jnp.where(pia, jnp.where(hit_any, 0,
+                           jnp.where(free_any, 1, INT_MIN)), result)
+        result = jnp.where(op(OP_MAP_GET), old, result)
+        result = jnp.where(op(OP_MAP_GET_OR_DEFAULT),
+                           jnp.where(hit_any, old, b), result)
+        result = jnp.where(op(OP_MAP_REMOVE), old, result)
+        result = jnp.where(op(OP_MAP_REMOVE_IF),
+                           (hit_any & (old == b)).astype(jnp.int32), result)
+        result = jnp.where(rep, jnp.where(hit_any, old, INT_MIN), result)
+        result = jnp.where(op(OP_MAP_REPLACE_IF), repif.astype(jnp.int32),
+                           result)
+        result = jnp.where(op(OP_MAP_CONTAINS_KEY),
+                           hit_any.astype(jnp.int32), result)
+        result = jnp.where(op(OP_MAP_CONTAINS_VALUE),
+                           jnp.any(m_alive & (mv == a[..., None]),
+                                   axis=-1).astype(jnp.int32), result)
+        result = jnp.where(op(OP_MAP_SIZE), m_size, result)
+        result = jnp.where(op(OP_MAP_IS_EMPTY),
+                           (m_size == 0).astype(jnp.int32), result)
+    else:
+        result = jnp.where(is_map, INT_MIN, result)
 
     # ---- set --------------------------------------------------------------
-    sk, sl, sdl = res.set_key, res.set_live, res.set_dl
-    s_alive = sl & ((sdl == 0) | (sdl > now[..., None]))
-    s_hit = s_alive & (sk == a[..., None])
-    s_hit_idx, s_hit_any = _first_true(s_hit)
-    s_free_idx, s_free_any = _first_true(~s_alive)
-
-    def sop(code):
-        return live & (opcode == code)
-
-    add = sop(OP_SET_ADD) & ~s_hit_any & s_free_any
-    sk = _scatter3(sk, s_free_idx, add, a)
-    sdl = _scatter3(sdl, s_free_idx, add,
-                    jnp.where(c > 0, now + c, 0))
-    sl = _scatter3(sl, s_free_idx, add, jnp.ones_like(a, bool))
-    srm = sop(OP_SET_REMOVE) & s_hit_any
-    sl = _scatter3(sl, s_hit_idx, srm, jnp.zeros_like(a, bool))
-    sl = jnp.where(sop(OP_SET_CLEAR)[..., None], False, sl)
     is_setop = live & (opcode >= OP_SET_ADD) & (opcode <= OP_SET_CLEAR)
-    sl = jnp.where(is_setop[..., None],
-                   sl & ((sdl == 0) | (sdl > now[..., None])), sl)
-    s_size = jnp.sum(s_alive, axis=-1).astype(jnp.int32)
-    result = jnp.where(sop(OP_SET_ADD),
-                       jnp.where(s_hit_any, 0,
-                                 jnp.where(s_free_any, 1, INT_MIN)), result)
-    result = jnp.where(sop(OP_SET_REMOVE), s_hit_any.astype(jnp.int32), result)
-    result = jnp.where(sop(OP_SET_CONTAINS), s_hit_any.astype(jnp.int32),
-                       result)
-    result = jnp.where(sop(OP_SET_SIZE), s_size, result)
+    if res.set_key.shape[-1] > 0:
+        sk, sl, sdl = res.set_key, res.set_live, res.set_dl
+        s_alive = sl & ((sdl == 0) | (sdl > now[..., None]))
+        s_hit = s_alive & (sk == a[..., None])
+        s_hit_idx, s_hit_any = _first_true(s_hit)
+        s_free_idx, s_free_any = _first_true(~s_alive)
+
+        add = op(OP_SET_ADD) & ~s_hit_any & s_free_any
+        sk = _scatter3(sk, s_free_idx, add, a)
+        sdl = _scatter3(sdl, s_free_idx, add, jnp.where(c > 0, now + c, 0))
+        sl = _scatter3(sl, s_free_idx, add, jnp.ones_like(a, bool))
+        srm = op(OP_SET_REMOVE) & s_hit_any
+        sl = _scatter3(sl, s_hit_idx, srm, jnp.zeros_like(a, bool))
+        sl = jnp.where(op(OP_SET_CLEAR)[..., None], False, sl)
+        sl = jnp.where(is_setop[..., None],
+                       sl & ((sdl == 0) | (sdl > now[..., None])), sl)
+        updates.update(set_key=sk, set_live=sl, set_dl=sdl)
+        s_size = jnp.sum(s_alive, axis=-1).astype(jnp.int32)
+        result = jnp.where(op(OP_SET_ADD),
+                           jnp.where(s_hit_any, 0,
+                                     jnp.where(s_free_any, 1, INT_MIN)),
+                           result)
+        result = jnp.where(op(OP_SET_REMOVE), s_hit_any.astype(jnp.int32),
+                           result)
+        result = jnp.where(op(OP_SET_CONTAINS), s_hit_any.astype(jnp.int32),
+                           result)
+        result = jnp.where(op(OP_SET_SIZE), s_size, result)
+    else:
+        result = jnp.where(is_setop, INT_MIN, result)
 
     # ---- queue ------------------------------------------------------------
-    qv, qh, qs = res.q_val, res.q_head, res.q_size
-    Q = qv.shape[-1]
-
-    def qop(code):
-        return live & (opcode == code)
-
-    offer = qop(OP_Q_OFFER)
-    can_push = offer & (qs < Q)
-    qv = _scatter3(qv, (qh + qs) % Q, can_push, a)
-    head_val = _gather3(qv, qh % Q)
-    poll = qop(OP_Q_POLL) & (qs > 0)
-    qs = jnp.where(can_push, qs + 1, qs)
-    qh = jnp.where(poll, qh + 1, qh)
-    qs = jnp.where(poll, qs - 1, qs)
-    qs = jnp.where(qop(OP_Q_CLEAR), 0, qs)
-    result = jnp.where(offer, can_push.astype(jnp.int32), result)
-    result = jnp.where(qop(OP_Q_POLL),
-                       jnp.where(poll, head_val, INT_MIN), result)
-    result = jnp.where(qop(OP_Q_PEEK),
-                       jnp.where(qs > 0, head_val, INT_MIN), result)
-    result = jnp.where(qop(OP_Q_SIZE), qs, result)
+    is_q = live & (opcode >= OP_Q_OFFER) & (opcode <= OP_Q_CLEAR)
+    if res.q_val.shape[-1] > 0:
+        qv, qh, qs = res.q_val, res.q_head, res.q_size
+        Q = qv.shape[-1]
+        offer = op(OP_Q_OFFER)
+        can_push = offer & (qs < Q)
+        qv = _scatter3(qv, (qh + qs) % Q, can_push, a)
+        head_val = _gather3(qv, qh % Q)
+        poll = op(OP_Q_POLL) & (qs > 0)
+        qs = jnp.where(can_push, qs + 1, qs)
+        qh = jnp.where(poll, qh + 1, qh)
+        qs = jnp.where(poll, qs - 1, qs)
+        qs = jnp.where(op(OP_Q_CLEAR), 0, qs)
+        updates.update(q_val=qv, q_head=qh, q_size=qs)
+        result = jnp.where(offer, can_push.astype(jnp.int32), result)
+        result = jnp.where(op(OP_Q_POLL),
+                           jnp.where(poll, head_val, INT_MIN), result)
+        result = jnp.where(op(OP_Q_PEEK),
+                           jnp.where(qs > 0, head_val, INT_MIN), result)
+        result = jnp.where(op(OP_Q_SIZE), qs, result)
+    else:
+        result = jnp.where(is_q, INT_MIN, result)
 
     # ---- lock -------------------------------------------------------------
     holder = res.lk_holder
-    wid, wdl, wlv = res.lk_wait_id, res.lk_wait_dl, res.lk_wait_live
-    lh, ls = res.lk_head, res.lk_size
-    W = wid.shape[-1]
     is_lock = live & (opcode >= OP_LOCK_ACQUIRE) & (opcode <= OP_LOCK_HOLDER)
-
-    # Lazily expire timed-out waiters, then compact the ring: dead slots
-    # (cancelled or expired anywhere in the window) must never wedge
-    # capacity. Stable argsort keeps FIFO order of the live entries.
-    pos = _ring_pos(lh, W)
-    in_win = pos < ls[..., None]
-    wlv = wlv & ~(is_lock[..., None] & in_win & (wdl <= now[..., None]))
-    live_win = wlv & in_win
-    any_dead = is_lock & jnp.any(in_win & ~wlv, axis=-1)
-    order = jnp.argsort(jnp.where(live_win, pos, W + pos), axis=-1)
-    count = jnp.sum(live_win, axis=-1).astype(jnp.int32)
-    dead3 = any_dead[..., None]
-    wid = jnp.where(dead3, jnp.take_along_axis(wid, order, axis=-1), wid)
-    wdl = jnp.where(dead3, jnp.take_along_axis(wdl, order, axis=-1), wdl)
-    wlv = jnp.where(dead3, jnp.arange(W)[None, None, :] < count[..., None],
-                    wlv)
-    lh = jnp.where(any_dead, 0, lh)
-    ls = jnp.where(any_dead, count, ls)
-
-    acq = live & (opcode == OP_LOCK_ACQUIRE)
-    rel = live & (opcode == OP_LOCK_RELEASE)
-    cxl = live & (opcode == OP_LOCK_CANCEL)
-
-    pos2 = _ring_pos(lh, W)
-    in_win2 = pos2 < ls[..., None]
-    queued_me = jnp.any(wlv & in_win2 & (wid == a[..., None]), axis=-1)
+    acq = op(OP_LOCK_ACQUIRE)
+    rel = op(OP_LOCK_RELEASE)
+    cxl = op(OP_LOCK_CANCEL)
     held_by_me = holder == a
-
     grant_now = acq & (holder == -1)
     holder = jnp.where(grant_now, a, holder)
     idem = acq & held_by_me          # retried acquire we already won
-    want_q = acq & ~grant_now & ~idem & ~queued_me & (b != 0)
-    q_ok = want_q & (ls < W)
-    q_dl = jnp.where(b < 0, INT_MAX, now + b)
-    wid = _scatter3(wid, (lh + ls) % W, q_ok, a)
-    wdl = _scatter3(wdl, (lh + ls) % W, q_ok, q_dl)
-    wlv = _scatter3(wlv, (lh + ls) % W, q_ok, jnp.ones_like(a, bool))
-    ls = jnp.where(q_ok, ls + 1, ls)
-
-    # release: hand to the first waiter (ring is compacted: head is live)
     do_rel = rel & held_by_me
-    next_id = _gather3(wid, lh % W)
-    has_next = do_rel & (ls > 0)
-    holder = jnp.where(do_rel, jnp.where(has_next, next_id, -1), holder)
-    lh = jnp.where(has_next, lh + 1, lh)
-    ls = jnp.where(has_next, ls - 1, ls)
+    W = res.lk_wait_id.shape[-1]
+    if W > 0:
+        wid, wdl, wlv = res.lk_wait_id, res.lk_wait_dl, res.lk_wait_live
+        lh, ls = res.lk_head, res.lk_size
 
-    # cancel: totally ordered with grants through the log, so the client's
-    # timeout decision is race-free (result 2 = you won before cancelling)
-    already = cxl & held_by_me
-    cxl_hit = wlv & in_win2 & (wid == a[..., None])
-    cxl_idx, cxl_found = _first_true(cxl_hit)
-    wlv = _scatter3(wlv, cxl_idx, cxl & ~already & cxl_found,
-                    jnp.zeros_like(a, bool))
+        # Lazily expire timed-out waiters, then compact the ring: dead
+        # slots (cancelled or expired anywhere in the window) must never
+        # wedge capacity. Stable compaction keeps FIFO order.
+        pos = _ring_pos(lh, W)
+        in_win = pos < ls[..., None]
+        wlv = wlv & ~(is_lock[..., None] & in_win & (wdl <= now[..., None]))
+        live_win = wlv & in_win
+        any_dead = is_lock & jnp.any(in_win & ~wlv, axis=-1)
+        lh, ls, wlv, (wid, wdl) = _ring_compact(
+            any_dead, lh, ls, pos, wlv, live_win, wid, wdl)
 
-    result = jnp.where(acq, jnp.where(
-        grant_now | idem, 1,
-        jnp.where(q_ok | queued_me, 2, 0)), result)
+        pos2 = _ring_pos(lh, W)
+        in_win2 = pos2 < ls[..., None]
+        queued_me = jnp.any(wlv & in_win2 & (wid == a[..., None]), axis=-1)
+
+        want_q = acq & ~grant_now & ~idem & ~queued_me & (b != 0)
+        q_ok = want_q & (ls < W)
+        q_dl = jnp.where(b < 0, INT_MAX, now + b)
+        wid = _scatter3(wid, (lh + ls) % W, q_ok, a)
+        wdl = _scatter3(wdl, (lh + ls) % W, q_ok, q_dl)
+        wlv = _scatter3(wlv, (lh + ls) % W, q_ok, jnp.ones_like(a, bool))
+        ls = jnp.where(q_ok, ls + 1, ls)
+
+        # release: hand to the first waiter (ring is compacted: head live)
+        next_id = _gather3(wid, lh % W)
+        has_next = do_rel & (ls > 0)
+        holder = jnp.where(do_rel,
+                           jnp.where(has_next, next_id, -1), holder)
+        lh = jnp.where(has_next, lh + 1, lh)
+        ls = jnp.where(has_next, ls - 1, ls)
+
+        # cancel: totally ordered with grants through the log, so the
+        # client's timeout decision is race-free (2 = won before cancel)
+        already = cxl & held_by_me
+        cxl_hit = wlv & in_win2 & (wid == a[..., None])
+        cxl_idx, cxl_found = _first_true(cxl_hit)
+        wlv = _scatter3(wlv, cxl_idx, cxl & ~already & cxl_found,
+                        jnp.zeros_like(a, bool))
+        updates.update(lk_wait_id=wid, lk_wait_dl=wdl, lk_wait_live=wlv,
+                       lk_head=lh, lk_size=ls)
+
+        result = jnp.where(acq, jnp.where(
+            grant_now | idem, 1,
+            jnp.where(q_ok | queued_me, 2, 0)), result)
+        result = jnp.where(cxl, jnp.where(already, 2,
+                           jnp.where(cxl_found, 1, 0)), result)
+        # Only queued-waiter grants are asynchronous; an immediate grant
+        # or failure reaches the client as the command's own result
+        ev_mask = ev_mask | has_next
+        ev_code = jnp.where(has_next, EV_LOCK_GRANT, ev_code)
+        ev_target = jnp.where(has_next, next_id, ev_target)
+        ev_arg = jnp.where(has_next, 1, ev_arg)
+    else:
+        holder = jnp.where(do_rel, -1, holder)
+        result = jnp.where(acq,
+                           jnp.where(grant_now | idem, 1, 0), result)
+        result = jnp.where(cxl, jnp.where(held_by_me, 2, 0), result)
+    updates["lk_holder"] = holder
     result = jnp.where(rel, do_rel.astype(jnp.int32), result)
-    result = jnp.where(cxl, jnp.where(already, 2,
-                       jnp.where(cxl_found, 1, 0)), result)
-    result = jnp.where(live & (opcode == OP_LOCK_HOLDER), holder, result)
-
-    # Only queued-waiter grants are asynchronous; an immediate grant or
-    # failure reaches the client as the command's own result, so no event
-    # is emitted (a stale event could be misread by a later attempt).
-    ev_mask = ev_mask | has_next
-    ev_code = jnp.where(has_next, EV_LOCK_GRANT, ev_code)
-    ev_target = jnp.where(has_next, next_id, ev_target)
-    ev_arg = jnp.where(has_next, 1, ev_arg)
+    result = jnp.where(op(OP_LOCK_HOLDER), holder, result)
 
     # ---- leader election --------------------------------------------------
     el, ep = res.el_leader, res.el_epoch
-    eid, elv, eh, es = res.el_id, res.el_live, res.el_head, res.el_size
-    Wl = eid.shape[-1]
     is_el = live & (opcode >= OP_ELECT_LISTEN) & (opcode <= OP_ELECT_GET_EPOCH)
-
-    # compact out unlisted waiters (same discipline as the lock ring)
-    e_pos = _ring_pos(eh, Wl)
-    e_in = e_pos < es[..., None]
-    e_live_win = elv & e_in
-    e_dead = is_el & jnp.any(e_in & ~elv, axis=-1)
-    e_order = jnp.argsort(jnp.where(e_live_win, e_pos, Wl + e_pos), axis=-1)
-    e_count = jnp.sum(e_live_win, axis=-1).astype(jnp.int32)
-    ed3 = e_dead[..., None]
-    eid = jnp.where(ed3, jnp.take_along_axis(eid, e_order, axis=-1), eid)
-    elv = jnp.where(ed3, jnp.arange(Wl)[None, None, :] < e_count[..., None],
-                    elv)
-    eh = jnp.where(e_dead, 0, eh)
-    es = jnp.where(e_dead, e_count, es)
-
-    listen = live & (opcode == OP_ELECT_LISTEN)
-    resign = live & (opcode == OP_ELECT_RESIGN)
-    isldr = live & (opcode == OP_ELECT_IS_LEADER)
-
-    e_pos2 = _ring_pos(eh, Wl)
-    e_in2 = e_pos2 < es[..., None]
-    listed = jnp.any(elv & e_in2 & (eid == a[..., None]), axis=-1)
+    listen = op(OP_ELECT_LISTEN)
+    resign = op(OP_ELECT_RESIGN)
     am_leader = el == a
-
     vacant = el == -1
     win_now = listen & vacant
     el = jnp.where(win_now, a, el)
     ep = jnp.where(win_now, index, ep)
-    # a retried listen by the sitting leader or a queued waiter is
-    # idempotent — no duplicate ring entry
-    el_q = listen & ~vacant & ~am_leader & ~listed & (es < Wl)
-    eid = _scatter3(eid, (eh + es) % Wl, el_q, a)
-    elv = _scatter3(elv, (eh + es) % Wl, el_q, jnp.ones_like(a, bool))
-    es = jnp.where(el_q, es + 1, es)
-    el_full = listen & ~vacant & ~am_leader & ~listed & ~el_q
-
-    # resign by the leader promotes the next listener (FIFO succession,
-    # LeaderElectionState.close:36-49); resign by a waiter unlists it
     do_res = resign & am_leader
-    succ_id = _gather3(eid, eh % Wl)
-    has_succ = do_res & (es > 0)
-    el = jnp.where(do_res, jnp.where(has_succ, succ_id, -1), el)
-    ep = jnp.where(has_succ, index, ep)
-    eh = jnp.where(has_succ, eh + 1, eh)
-    es = jnp.where(has_succ, es - 1, es)
-    e_hit = elv & e_in2 & (eid == a[..., None])
-    e_idx, e_found = _first_true(e_hit)
-    elv = _scatter3(elv, e_idx, resign & ~do_res & e_found,
-                    jnp.zeros_like(a, bool))
+    Wl = res.el_id.shape[-1]
+    if Wl > 0:
+        eid, elv, eh, es = res.el_id, res.el_live, res.el_head, res.el_size
 
-    result = jnp.where(listen, jnp.where(win_now, index,
-                       jnp.where(am_leader, ep,
-                       jnp.where(el_full, INT_MIN, 0))), result)
+        # compact out unlisted waiters (same discipline as the lock ring)
+        e_pos = _ring_pos(eh, Wl)
+        e_in = e_pos < es[..., None]
+        e_live_win = elv & e_in
+        e_dead = is_el & jnp.any(e_in & ~elv, axis=-1)
+        eh, es, elv, (eid,) = _ring_compact(
+            e_dead, eh, es, e_pos, elv, e_live_win, eid)
+
+        e_pos2 = _ring_pos(eh, Wl)
+        e_in2 = e_pos2 < es[..., None]
+        listed = jnp.any(elv & e_in2 & (eid == a[..., None]), axis=-1)
+
+        # a retried listen by the sitting leader or a queued waiter is
+        # idempotent — no duplicate ring entry
+        el_q = listen & ~vacant & ~am_leader & ~listed & (es < Wl)
+        eid = _scatter3(eid, (eh + es) % Wl, el_q, a)
+        elv = _scatter3(elv, (eh + es) % Wl, el_q, jnp.ones_like(a, bool))
+        es = jnp.where(el_q, es + 1, es)
+        el_full = listen & ~vacant & ~am_leader & ~listed & ~el_q
+
+        # resign by the leader promotes the next listener (FIFO
+        # succession, LeaderElectionState.close:36-49); by a waiter unlists
+        succ_id = _gather3(eid, eh % Wl)
+        has_succ = do_res & (es > 0)
+        el = jnp.where(do_res, jnp.where(has_succ, succ_id, -1), el)
+        ep = jnp.where(has_succ, index, ep)
+        eh = jnp.where(has_succ, eh + 1, eh)
+        es = jnp.where(has_succ, es - 1, es)
+        e_hit = elv & e_in2 & (eid == a[..., None])
+        e_idx, e_found = _first_true(e_hit)
+        elv = _scatter3(elv, e_idx, resign & ~do_res & e_found,
+                        jnp.zeros_like(a, bool))
+        updates.update(el_id=eid, el_live=elv, el_head=eh, el_size=es)
+
+        result = jnp.where(listen, jnp.where(win_now, index,
+                           jnp.where(am_leader, ep,
+                           jnp.where(el_full, INT_MIN, 0))), result)
+        ev_mask = ev_mask | has_succ
+        ev_code = jnp.where(has_succ, EV_ELECT, ev_code)
+        ev_target = jnp.where(has_succ, succ_id, ev_target)
+        ev_arg = jnp.where(has_succ, index, ev_arg)
+    else:
+        el = jnp.where(do_res, -1, el)
+        result = jnp.where(listen, jnp.where(win_now, index,
+                           jnp.where(am_leader, ep, INT_MIN)), result)
+    updates.update(el_leader=el, el_epoch=ep)
     result = jnp.where(resign, do_res.astype(jnp.int32), result)
-    result = jnp.where(isldr, (am_leader & (ep == b)).astype(jnp.int32),
-                       result)
-    result = jnp.where(live & (opcode == OP_ELECT_LEADER), el, result)
-    result = jnp.where(live & (opcode == OP_ELECT_GET_EPOCH), ep, result)
-
-    # Symmetric with locks: an immediate win is the listen command's own
-    # result; only FIFO promotions are delivered as events.
-    ev_mask = ev_mask | has_succ
-    ev_code = jnp.where(has_succ, EV_ELECT, ev_code)
-    ev_target = jnp.where(has_succ, succ_id, ev_target)
-    ev_arg = jnp.where(has_succ, index, ev_arg)
+    result = jnp.where(op(OP_ELECT_IS_LEADER),
+                       (am_leader & (ep == b)).astype(jnp.int32), result)
+    result = jnp.where(op(OP_ELECT_LEADER), el, result)
+    result = jnp.where(op(OP_ELECT_GET_EPOCH), ep, result)
 
     # ---- push the (single) session event into the outbox ring -------------
-    evc, evt, eva = res.ev_code, res.ev_target, res.ev_arg
-    evh, evtl = res.ev_head, res.ev_tail
-    E = evc.shape[-1]
-    overflow = ev_mask & ((evtl - evh) >= E)
-    evh = jnp.where(overflow, evh + 1, evh)  # drop oldest
-    slot = evtl % E
-    evc = _scatter3(evc, slot, ev_mask, ev_code)
-    evt = _scatter3(evt, slot, ev_mask, ev_target)
-    eva = _scatter3(eva, slot, ev_mask, ev_arg)
-    evtl = jnp.where(ev_mask, evtl + 1, evtl)
+    E = res.ev_code.shape[-1]
+    if E > 0:
+        evc, evt, eva = res.ev_code, res.ev_target, res.ev_arg
+        evh, evtl = res.ev_head, res.ev_tail
+        overflow = ev_mask & ((evtl - evh) >= E)
+        evh = jnp.where(overflow, evh + 1, evh)  # drop oldest
+        slot = evtl % E
+        evc = _scatter3(evc, slot, ev_mask, ev_code)
+        evt = _scatter3(evt, slot, ev_mask, ev_target)
+        eva = _scatter3(eva, slot, ev_mask, ev_arg)
+        evtl = jnp.where(ev_mask, evtl + 1, evtl)
+        updates.update(ev_code=evc, ev_target=evt, ev_arg=eva,
+                       ev_head=evh, ev_tail=evtl)
 
-    new_res = ResourceState(
-        value=value, val_dl=val_dl,
-        map_key=mk, map_val=mv, map_live=ml, map_dl=mdl,
-        set_key=sk, set_live=sl, set_dl=sdl,
-        q_val=qv, q_head=qh, q_size=qs,
-        lk_holder=holder, lk_wait_id=wid, lk_wait_dl=wdl, lk_wait_live=wlv,
-        lk_head=lh, lk_size=ls,
-        el_leader=el, el_epoch=ep, el_id=eid, el_live=elv,
-        el_head=eh, el_size=es,
-        ev_code=evc, ev_target=evt, ev_arg=eva, ev_head=evh, ev_tail=evtl,
-    )
-    return new_res, result
+    return res._replace(**updates), result
 
 
 def drain_events(res: ResourceState, n: int, mask: jnp.ndarray
@@ -588,8 +626,12 @@ def drain_events(res: ResourceState, n: int, mask: jnp.ndarray
     on an active leader means events emitted during leaderless rounds stay
     queued until someone can deliver them (at-least-once).
     """
-    evh, evtl = res.ev_head, res.ev_tail
     E = res.ev_code.shape[-1]
+    G, P = res.ev_head.shape
+    if E == 0 or n == 0:
+        z = jnp.zeros((G, P, n), jnp.int32)
+        return res, (z, z, z, z, jnp.zeros((G, P, n), bool))
+    evh, evtl = res.ev_head, res.ev_tail
     lane_mask = mask[:, None]
     seqs, codes, targets, args, valids = [], [], [], [], []
     for i in range(n):

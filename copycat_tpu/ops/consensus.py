@@ -117,6 +117,7 @@ class Config(NamedTuple):
     timer_max: int = 9
     events_per_round: int = 4  # outbox events drained per step
     resource: ResourceConfig = ResourceConfig()
+    use_pallas: bool = False  # Pallas quorum-tally kernel (TPU hot path)
 
 
 def init_state(num_groups: int, num_peers: int, log_slots: int,
@@ -278,12 +279,18 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
     l_log_tag = _peer_view(state.log_tag, lead)
     l_clock = jnp.max(clock1, axis=1)              # [G] (identical per lane)
 
+    # Quorum tallies = k-th largest over the peer axis; Pallas kernel on
+    # the TPU hot path, closed-form jnp selection otherwise.
+    if config.use_pallas:
+        from .pallas_kernels import kth_largest_pallas as _kth
+    else:
+        from .pallas_kernels import kth_largest as _kth
+
     # ---- phase 1: inject client submits into the leader log ----
     # Backpressure: never let the ring overwrite entries the leader itself or
     # a quorum-th replica still has to apply (laggards beyond the window go
     # stale and are snapshot-installed by the host).
-    applied_sorted = jnp.sort(state.applied_index, axis=1)[:, ::-1]
-    q_applied = applied_sorted[:, quorum - 1]
+    q_applied = _kth(state.applied_index, quorum)
     allowed_last = jnp.minimum(l_applied, q_applied) + L
 
     valid = submits.valid & active[:, None]
@@ -392,7 +399,7 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
 
     self_lane = peer_ids[None, :] == lead[:, None]
     match_full = jnp.where(self_lane, l_last[:, None], l_match)
-    cand_commit = jnp.sort(match_full, axis=1)[:, ::-1][:, quorum - 1]
+    cand_commit = _kth(match_full, quorum)
     cand_commit_term = _term_at_2d(l_log_term, l_last, cand_commit[:, None])[:, 0]
     advance = active & ~leader_stale & (cand_commit > l_commit) \
         & (cand_commit_term == l_term)

@@ -323,6 +323,47 @@ def test_value_ttl_survives_failed_cas():
 
 
 # ---------------------------------------------------------------------------
+# zero-size pools: compiled out, ops fail cleanly (ResourceConfig)
+# ---------------------------------------------------------------------------
+
+def test_counters_only_config():
+    from copycat_tpu.ops.apply import ResourceConfig
+    from copycat_tpu.ops.consensus import Config
+
+    rg = RaftGroups(1, 3, log_slots=32,
+                    config=Config(resource=ResourceConfig.counters_only()))
+    rg.wait_for_leaders()
+    # counters fully work
+    res = run_ops(rg, [(ap.OP_LONG_ADD, 5), (ap.OP_LONG_ADD, 5),
+                       (ap.OP_VALUE_GET,)])
+    assert res == [5, 10, 10]
+    # disabled pools fail cleanly with the sentinel
+    res = run_ops(rg, [(ap.OP_MAP_PUT, 1, 2), (ap.OP_SET_ADD, 1),
+                       (ap.OP_Q_OFFER, 1)])
+    assert res == [FAIL, FAIL, FAIL]
+    # lock still works in try-lock-only mode (no wait ring)
+    res = run_ops(rg, [
+        (ap.OP_LOCK_ACQUIRE, 7, 0),   # grant
+        (ap.OP_LOCK_ACQUIRE, 8, -1),  # would queue; no ring -> fail (0)
+        (ap.OP_LOCK_HOLDER,),
+        (ap.OP_LOCK_RELEASE, 7),
+        (ap.OP_LOCK_HOLDER,),
+    ])
+    assert res == [1, 0, 7, 1, -1]
+    # election works leader-only (no succession ring)
+    res = run_ops(rg, [(ap.OP_ELECT_LISTEN, 5)])
+    epoch = res[0]
+    assert epoch > 0
+    res = run_ops(rg, [
+        (ap.OP_ELECT_LISTEN, 6),      # no ring -> FAIL
+        (ap.OP_ELECT_IS_LEADER, 5, epoch),
+        (ap.OP_ELECT_RESIGN, 5),
+        (ap.OP_ELECT_LEADER,),
+    ])
+    assert res == [FAIL, 1, 1, -1]
+
+
+# ---------------------------------------------------------------------------
 # convergence: replicated pools stay identical across replicas
 # ---------------------------------------------------------------------------
 
