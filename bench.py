@@ -88,6 +88,13 @@ def current_leaders(state) -> jnp.ndarray:
     return jnp.where(active, lead, -1)
 
 
+def tile_pattern(pattern, G: int) -> jnp.ndarray:
+    """Tile a short per-slot pattern across [G, SUBMIT_SLOTS]."""
+    pat = jnp.asarray(pattern, jnp.int32)
+    row = pat[jnp.arange(SUBMIT_SLOTS) % pat.size]
+    return jnp.broadcast_to(row, (G, SUBMIT_SLOTS))
+
+
 def counter_submits(G: int) -> Submits:
     ones = jnp.ones((G, SUBMIT_SLOTS), jnp.int32)
     return Submits(opcode=ones * ap.OP_LONG_ADD, a=ones, b=ones * 0,
@@ -97,11 +104,9 @@ def counter_submits(G: int) -> Submits:
 def map_submits(G: int) -> Submits:
     """put/put/get/get over rotating keys (hashed-keyspace kernel)."""
     ones = jnp.ones((G, SUBMIT_SLOTS), jnp.int32)
-    opc = jnp.asarray([ap.OP_MAP_PUT, ap.OP_MAP_PUT,
-                       ap.OP_MAP_GET, ap.OP_MAP_GET], jnp.int32)
-    keys = jnp.asarray([1, 2, 1, 2], jnp.int32)
-    return Submits(opcode=jnp.broadcast_to(opc, (G, SUBMIT_SLOTS)),
-                   a=jnp.broadcast_to(keys, (G, SUBMIT_SLOTS)),
+    opc = [ap.OP_MAP_PUT, ap.OP_MAP_PUT, ap.OP_MAP_GET, ap.OP_MAP_GET]
+    keys = [1, 2, 1, 2]
+    return Submits(opcode=tile_pattern(opc, G), a=tile_pattern(keys, G),
                    b=ones * 7, c=ones * 0, tag=ones,
                    valid=ones.astype(bool))
 
@@ -112,25 +117,23 @@ def lock_submits(G: int) -> Submits:
     Every round drives the full grant chain including the event-push path.
     """
     ones = jnp.ones((G, SUBMIT_SLOTS), jnp.int32)
-    opc = jnp.asarray([ap.OP_LOCK_ACQUIRE, ap.OP_LOCK_ACQUIRE,
-                       ap.OP_LOCK_RELEASE, ap.OP_LOCK_RELEASE], jnp.int32)
-    who = jnp.asarray([1, 2, 1, 2], jnp.int32)
-    waitflag = jnp.asarray([-1, -1, 0, 0], jnp.int32)
-    return Submits(opcode=jnp.broadcast_to(opc, (G, SUBMIT_SLOTS)),
-                   a=jnp.broadcast_to(who, (G, SUBMIT_SLOTS)),
-                   b=jnp.broadcast_to(waitflag, (G, SUBMIT_SLOTS)),
+    opc = [ap.OP_LOCK_ACQUIRE, ap.OP_LOCK_ACQUIRE,
+           ap.OP_LOCK_RELEASE, ap.OP_LOCK_RELEASE]
+    who = [1, 2, 1, 2]
+    waitflag = [-1, -1, 0, 0]
+    return Submits(opcode=tile_pattern(opc, G), a=tile_pattern(who, G),
+                   b=tile_pattern(waitflag, G),
                    c=ones * 0, tag=ones, valid=ones.astype(bool))
 
 
 def mixed_submits(G: int) -> Submits:
     ones = jnp.ones((G, SUBMIT_SLOTS), jnp.int32)
-    opc = jnp.asarray([ap.OP_LONG_ADD, ap.OP_MAP_PUT,
-                       ap.OP_LOCK_ACQUIRE, ap.OP_LOCK_RELEASE], jnp.int32)
-    a = jnp.asarray([1, 3, 9, 9], jnp.int32)
-    b = jnp.asarray([0, 5, -1, 0], jnp.int32)
-    return Submits(opcode=jnp.broadcast_to(opc, (G, SUBMIT_SLOTS)),
-                   a=jnp.broadcast_to(a, (G, SUBMIT_SLOTS)),
-                   b=jnp.broadcast_to(b, (G, SUBMIT_SLOTS)),
+    opc = [ap.OP_LONG_ADD, ap.OP_MAP_PUT,
+           ap.OP_LOCK_ACQUIRE, ap.OP_LOCK_RELEASE]
+    a = [1, 3, 9, 9]
+    b = [0, 5, -1, 0]
+    return Submits(opcode=tile_pattern(opc, G), a=tile_pattern(a, G),
+                   b=tile_pattern(b, G),
                    c=ones * 0, tag=ones, valid=ones.astype(bool))
 
 

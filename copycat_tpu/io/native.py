@@ -35,7 +35,7 @@ from .transport import (
 )
 
 _REQUEST, _RESPONSE, _ERROR = 0, 1, 2
-_ETYPE_ACCEPT, _ETYPE_FRAME, _ETYPE_CLOSE = 1, 2, 3
+_ETYPE_ACCEPT, _ETYPE_FRAME, _ETYPE_CLOSE, _ETYPE_CONNECT = 1, 2, 3, 4
 
 _NATIVE_DIR = pathlib.Path(__file__).resolve().parents[2] / "native"
 _LIB_PATH = _NATIVE_DIR / "libcopycat_native.so"
@@ -135,7 +135,7 @@ class _NativeLoop:
         # their order only inside the loop.
         def deliver() -> None:
             if etype == _ETYPE_ACCEPT:
-                fn = self._accepts.get(corr)  # corr = listener fd
+                fn = self._accepts.get(corr)  # corr = listener conn id
                 if fn is not None:
                     fn(conn)
                 return
@@ -149,19 +149,21 @@ class _NativeLoop:
             pass
 
     # thin C wrappers -----------------------------------------------------
+    # The ints below are loop-assigned conn ids (generation-safe), not raw
+    # fds: the kernel reuses fd numbers, ids are never reused.
     def listen(self, address: Address) -> int:
-        fd = self._lib.cn_listen(self._handle, address.host.encode(),
-                                 address.port)
-        if fd < 0:
+        conn = self._lib.cn_listen(self._handle, address.host.encode(),
+                                   address.port)
+        if conn < 0:
             raise TransportError(f"cannot listen on {address}")
-        return fd
+        return conn
 
     def connect(self, address: Address) -> int:
-        fd = self._lib.cn_connect(self._handle, address.host.encode(),
-                                  address.port)
-        if fd < 0:
+        conn = self._lib.cn_connect(self._handle, address.host.encode(),
+                                    address.port)
+        if conn < 0:
             raise TransportError(f"cannot connect to {address}")
-        return fd
+        return conn
 
     def send(self, conn: int, kind: int, corr: int, payload: bytes) -> None:
         if self._lib.cn_send(self._handle, conn, kind, corr, payload,
@@ -181,18 +183,28 @@ class _NativeLoop:
 class NativeConnection(Connection):
     """Frame-level I/O lives in C++; request/response correlation here."""
 
-    def __init__(self, loop: _NativeLoop, fd: int,
-                 serializer: Serializer) -> None:
+    def __init__(self, loop: _NativeLoop, fd: int, serializer: Serializer,
+                 awaits_connect: bool = False) -> None:
         super().__init__()
         self._loop = loop
         self._fd = fd
         self._serializer = serializer
         self._next_id = 0
         self._pending: dict[int, asyncio.Future] = {}
+        # Client side: connects are nonblocking in C; completion (or
+        # refusal) arrives as an event, awaited before connect() returns
+        # so the fail-fast contract of TcpTransport is preserved.
+        self._ready: asyncio.Future | None = (
+            asyncio.get_running_loop().create_future() if awaits_connect
+            else None)
         loop._routes[fd] = self._on_event
 
     def _on_event(self, etype: int, kind: int, corr: int,
                   payload: bytes) -> None:
+        if etype == _ETYPE_CONNECT:
+            if self._ready is not None and not self._ready.done():
+                self._ready.set_result(True)
+            return
         if etype == _ETYPE_CLOSE:
             self._abort()
             return
@@ -232,6 +244,9 @@ class NativeConnection(Connection):
 
     def _abort(self) -> None:
         self._loop._routes.pop(self._fd, None)
+        if self._ready is not None and not self._ready.done():
+            self._ready.set_exception(
+                ConnectionClosedError("connect failed"))
         for future in self._pending.values():
             if not future.done():
                 future.set_exception(ConnectionClosedError("connection closed"))
@@ -250,9 +265,22 @@ class NativeTcpClient(Client):
         self._connections: list[NativeConnection] = []
 
     async def connect(self, address: Address) -> Connection:
-        self._loop.bind_asyncio(asyncio.get_running_loop())
-        fd = self._loop.connect(address)
-        conn = NativeConnection(self._loop, fd, Serializer())
+        aio = asyncio.get_running_loop()
+        self._loop.bind_asyncio(aio)
+        # Resolve on the asyncio resolver (thread pool) so a slow DNS
+        # lookup never blocks the event loop; C gets a numeric host.
+        import socket
+        infos = await aio.getaddrinfo(address.host or "127.0.0.1",
+                                      address.port, family=socket.AF_INET,
+                                      type=socket.SOCK_STREAM)
+        numeric = Address(infos[0][4][0], address.port)
+        fd = self._loop.connect(numeric)
+        conn = NativeConnection(self._loop, fd, Serializer(),
+                                awaits_connect=True)
+        try:
+            await conn._ready  # fail-fast: refused connects raise here
+        except ConnectionClosedError as exc:
+            raise TransportError(f"cannot connect to {address}") from exc
         self._connections.append(conn)
         conn.on_close(lambda c: self._connections.remove(c)
                       if c in self._connections else None)
@@ -272,7 +300,14 @@ class NativeTcpServer(Server):
 
     async def listen(self, address: Address,
                      on_connect: Callable[[Connection], None]) -> None:
-        self._loop.bind_asyncio(asyncio.get_running_loop())
+        aio = asyncio.get_running_loop()
+        self._loop.bind_asyncio(aio)
+        if address.host:
+            import socket
+            infos = await aio.getaddrinfo(address.host, address.port,
+                                          family=socket.AF_INET,
+                                          type=socket.SOCK_STREAM)
+            address = Address(infos[0][4][0], address.port)
         self._listener = self._loop.listen(address)
 
         def accept(fd: int) -> None:

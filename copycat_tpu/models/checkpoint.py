@@ -37,6 +37,12 @@ def save(rg, path: str | pathlib.Path) -> None:
         "clock": rg.clock,
         "next_tag": rg._next_tag,
         "ev_seen": rg._ev_seen,
+        # the host-side event buffer (consumption cursors are facade-local,
+        # so this includes consumed events): restores the buffer faithfully
+        # and keeps seq dedup (_ev_seen) consistent with it. Facades
+        # created after restore start their cursor past these (session
+        # events die with the session) and re-query authoritative state.
+        "events": {str(g): evs for g, evs in rg.events.items()},
         "key": np.asarray(rg._key).tolist(),
         "num_leaves": len(leaves),
     }
@@ -73,6 +79,8 @@ def load(path: str | pathlib.Path, mesh=None):
         rg.clock = meta["clock"]
         rg._next_tag = meta["next_tag"]
         rg._ev_seen = {int(k): int(v) for k, v in meta["ev_seen"].items()}
+        rg.events = {int(g): [tuple(e) for e in evs]
+                     for g, evs in meta.get("events", {}).items()}
         import jax.numpy as jnp
         rg._key = jnp.asarray(np.asarray(meta["key"], np.uint32))
     return rg

@@ -44,7 +44,14 @@ class DeviceResource:
     def __init__(self, groups: "raft_groups.RaftGroups", group: int) -> None:
         self._rg = groups
         self._group = group
-        self._ev_last = -1  # absolute event seq already consumed
+        # Events buffered before this facade existed were addressed to
+        # predecessor facades (reference semantic: session events die with
+        # the session, ManagedResourceSession.java) — start the cursor past
+        # them so e.g. a stale lock grant can never satisfy a new holder.
+        # Recovery after restore/event-loss goes through the authoritative
+        # registers instead (OP_LOCK_HOLDER / OP_ELECT_LEADER fallbacks).
+        evs = groups.events.get(group, [])
+        self._ev_last = evs[-1][0] if evs else -1
 
     def _call(self, opcode: int, a: int = 0, b: int = 0, c: int = 0) -> int:
         tag = self._rg.submit(self._group, opcode, a, b, c)
@@ -283,6 +290,7 @@ class DeviceElection(DeviceResource):
         # promotions won but resigned before ever being polled: the elect
         # event is still in flight and must not satisfy a future listen
         self._swallow_elect = 0
+        self._unresolved_polls = 0
 
     def listen(self) -> int | None:
         """Enter the election; returns the epoch if elected immediately."""
@@ -299,6 +307,14 @@ class DeviceElection(DeviceResource):
                     self._swallow_elect -= 1
                     continue
                 self.epoch = arg
+        if self.epoch is None:
+            # The elect event can be lost to outbox-ring overflow (drop-
+            # oldest) or host-buffer trimming; every 20 unresolved polls
+            # consult the authoritative replicated leader register instead
+            # (mirrors DeviceLock._await_grant's fallback cadence).
+            self._unresolved_polls += 1
+            if self._unresolved_polls % 20 == 0:
+                return self.refresh()
         return self.epoch
 
     def refresh(self) -> int | None:

@@ -9,9 +9,15 @@
 // mutex+condvar event queue polled via cn_poll. Sends are enqueued from
 // any thread and flushed by the loop (eventfd wakeup).
 //
+// Connections are identified by a monotonically increasing conn id, never
+// by raw fd: the kernel reuses fd numbers immediately, so a stale
+// ETYPE_CLOSE routed by fd could hit a new connection. The id rides in
+// epoll_event.data.u64.
+//
 // Exposed as a plain C ABI for ctypes (no pybind11 in the image).
 
 #include <arpa/inet.h>
+#include <atomic>
 #include <cerrno>
 #include <chrono>
 #include <cstdint>
@@ -21,6 +27,7 @@
 #include <map>
 #include <mutex>
 #include <condition_variable>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <pthread.h>
@@ -36,8 +43,10 @@ namespace {
 constexpr int ETYPE_ACCEPT = 1;
 constexpr int ETYPE_FRAME = 2;
 constexpr int ETYPE_CLOSE = 3;
+constexpr int ETYPE_CONNECT = 4;  // nonblocking connect completed ok
 constexpr size_t HEADER = 4 + 1 + 8;
 constexpr size_t MAX_FRAME = 64 * 1024 * 1024;
+constexpr uint64_t WAKE_ID = 0;  // reserved conn id for the wake eventfd
 
 struct Event {
   int conn;
@@ -48,8 +57,10 @@ struct Event {
 };
 
 struct Conn {
+  int id = -1;
   int fd = -1;
   bool listener = false;
+  bool connecting = false;  // nonblocking connect not yet completed
   std::vector<uint8_t> rbuf;
   std::deque<std::vector<uint8_t>> wq;  // pending encoded frames
   size_t wq_off = 0;                    // offset into wq.front()
@@ -59,11 +70,12 @@ struct Loop {
   int epfd = -1;
   int wakefd = -1;
   pthread_t thread{};
-  bool running = false;
+  std::atomic<bool> running{false};
 
-  std::mutex mu;                 // guards conns / cmds
-  std::map<int, Conn> conns;     // fd -> state
-  std::deque<std::pair<int, std::vector<uint8_t>>> cmds;  // (fd, frame)
+  std::mutex mu;                 // guards conns / cmds / next_id
+  int next_id = 1;               // 0 reserved for the wake fd
+  std::map<int, Conn> conns;     // conn id -> state
+  std::deque<std::pair<int, std::vector<uint8_t>>> cmds;  // (id, frame)
   std::deque<int> closing;
 
   std::mutex evmu;
@@ -89,22 +101,53 @@ void set_nonblock(int fd) {
   fcntl(fd, F_SETFL, flags | O_NONBLOCK);
 }
 
-void epoll_update(Loop* l, int fd, bool want_write) {
-  epoll_event ev{};
-  ev.events = EPOLLIN | (want_write ? EPOLLOUT : 0u);
-  ev.data.fd = fd;
-  epoll_ctl(l->epfd, EPOLL_CTL_MOD, fd, &ev);
+// Resolve host (name or numeric) to an IPv4 sockaddr; empty host maps to
+// INADDR_ANY for listeners and loopback for connects.
+bool resolve_ipv4(const char* host, int port, bool passive,
+                  sockaddr_in* out) {
+  std::memset(out, 0, sizeof(*out));
+  out->sin_family = AF_INET;
+  out->sin_port = htons(uint16_t(port));
+  if (!host || !*host) {
+    out->sin_addr.s_addr = passive ? htonl(INADDR_ANY)
+                                   : htonl(INADDR_LOOPBACK);
+    return true;
+  }
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  if (passive) hints.ai_flags = AI_PASSIVE;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host, nullptr, &hints, &res) != 0 || res == nullptr)
+    return false;
+  out->sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+  freeaddrinfo(res);
+  return true;
 }
 
-void close_conn_locked(Loop* l, int fd, bool emit) {
-  auto it = l->conns.find(fd);
+void epoll_update(Loop* l, const Conn& c, bool want_write) {
+  epoll_event ev{};
+  ev.events = EPOLLIN | (want_write ? EPOLLOUT : 0u);
+  ev.data.u64 = uint64_t(c.id);
+  epoll_ctl(l->epfd, EPOLL_CTL_MOD, c.fd, &ev);
+}
+
+void epoll_add(Loop* l, const Conn& c, bool want_write) {
+  epoll_event ev{};
+  ev.events = EPOLLIN | (want_write ? EPOLLOUT : 0u);
+  ev.data.u64 = uint64_t(c.id);
+  epoll_ctl(l->epfd, EPOLL_CTL_ADD, c.fd, &ev);
+}
+
+void close_conn_locked(Loop* l, int id, bool emit) {
+  auto it = l->conns.find(id);
   if (it == l->conns.end()) return;
-  epoll_ctl(l->epfd, EPOLL_CTL_DEL, fd, nullptr);
-  close(fd);
+  epoll_ctl(l->epfd, EPOLL_CTL_DEL, it->second.fd, nullptr);
+  close(it->second.fd);
   bool listener = it->second.listener;
   l->conns.erase(it);
   if (emit && !listener)
-    l->push_event(Event{fd, ETYPE_CLOSE, 0, 0, {}});
+    l->push_event(Event{id, ETYPE_CLOSE, 0, 0, {}});
 }
 
 // parse complete frames out of c->rbuf
@@ -115,14 +158,14 @@ void drain_frames(Loop* l, Conn* c) {
     uint32_t len = (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
                    (uint32_t(p[2]) << 8) | uint32_t(p[3]);
     if (len > MAX_FRAME) {  // poisoned stream: drop the connection
-      close_conn_locked(l, c->fd, true);
+      close_conn_locked(l, c->id, true);
       return;
     }
     if (c->rbuf.size() - off < HEADER + len) break;
     uint8_t kind = p[4];
     uint64_t corr = 0;
     for (int i = 0; i < 8; i++) corr = (corr << 8) | p[5 + i];
-    Event e{c->fd, ETYPE_FRAME, kind, corr, {}};
+    Event e{c->id, ETYPE_FRAME, kind, corr, {}};
     e.payload.assign(p + HEADER, p + HEADER + len);
     l->push_event(std::move(e));
     off += HEADER + len;
@@ -130,60 +173,72 @@ void drain_frames(Loop* l, Conn* c) {
   if (off > 0) c->rbuf.erase(c->rbuf.begin(), c->rbuf.begin() + off);
 }
 
-void handle_readable(Loop* l, int fd) {
-  auto it = l->conns.find(fd);
+void handle_readable(Loop* l, int id) {
+  auto it = l->conns.find(id);
   if (it == l->conns.end()) return;
   Conn& c = it->second;
   if (c.listener) {
     for (;;) {
-      int cfd = accept(fd, nullptr, nullptr);
+      int cfd = accept(c.fd, nullptr, nullptr);
       if (cfd < 0) break;
       set_nonblock(cfd);
       int one = 1;
       setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       Conn nc;
+      nc.id = l->next_id++;
       nc.fd = cfd;
-      l->conns.emplace(cfd, std::move(nc));
-      epoll_event ev{};
-      ev.events = EPOLLIN;
-      ev.data.fd = cfd;
-      epoll_ctl(l->epfd, EPOLL_CTL_ADD, cfd, &ev);
-      // corr carries the listener fd so Python can route the accept
-      l->push_event(Event{cfd, ETYPE_ACCEPT, 0, uint64_t(fd), {}});
+      epoll_add(l, nc, false);
+      int nid = nc.id;
+      l->conns.emplace(nid, std::move(nc));
+      // corr carries the listener's conn id so Python can route the accept
+      l->push_event(Event{nid, ETYPE_ACCEPT, 0, uint64_t(id), {}});
     }
     return;
   }
   char buf[65536];
   for (;;) {
-    ssize_t n = recv(fd, buf, sizeof(buf), 0);
+    ssize_t n = recv(c.fd, buf, sizeof(buf), 0);
     if (n > 0) {
       c.rbuf.insert(c.rbuf.end(), buf, buf + n);
       if (c.rbuf.size() >= HEADER) drain_frames(l, &c);
-      if (l->conns.find(fd) == l->conns.end()) return;  // dropped mid-parse
+      if (l->conns.find(id) == l->conns.end()) return;  // dropped mid-parse
     } else if (n == 0) {
-      close_conn_locked(l, fd, true);
+      close_conn_locked(l, id, true);
       return;
     } else {
       if (errno == EAGAIN || errno == EWOULDBLOCK) break;
       if (errno == EINTR) continue;
-      close_conn_locked(l, fd, true);
+      close_conn_locked(l, id, true);
       return;
     }
   }
 }
 
-void handle_writable(Loop* l, int fd) {
-  auto it = l->conns.find(fd);
+void handle_writable(Loop* l, int id) {
+  auto it = l->conns.find(id);
   if (it == l->conns.end()) return;
   Conn& c = it->second;
+  if (c.connecting) {  // nonblocking connect completed (or failed)
+    int err = 0;
+    socklen_t len = sizeof(err);
+    getsockopt(c.fd, SOL_SOCKET, SO_ERROR, &err, &len);
+    if (err != 0) {
+      close_conn_locked(l, id, true);
+      return;
+    }
+    c.connecting = false;
+    int one = 1;
+    setsockopt(c.fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    l->push_event(Event{id, ETYPE_CONNECT, 0, 0, {}});
+  }
   while (!c.wq.empty()) {
     auto& front = c.wq.front();
-    ssize_t n = send(fd, front.data() + c.wq_off, front.size() - c.wq_off,
+    ssize_t n = send(c.fd, front.data() + c.wq_off, front.size() - c.wq_off,
                      MSG_NOSIGNAL);
     if (n < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) return;
       if (errno == EINTR) continue;
-      close_conn_locked(l, fd, true);
+      close_conn_locked(l, id, true);
       return;
     }
     c.wq_off += size_t(n);
@@ -192,44 +247,45 @@ void handle_writable(Loop* l, int fd) {
       c.wq_off = 0;
     }
   }
-  epoll_update(l, fd, false);
+  epoll_update(l, c, false);
 }
 
 void* loop_main(void* arg) {
   Loop* l = static_cast<Loop*>(arg);
   epoll_event evs[128];
-  while (l->running) {
+  while (l->running.load(std::memory_order_acquire)) {
     int n = epoll_wait(l->epfd, evs, 128, 200);
     std::lock_guard<std::mutex> g(l->mu);
     for (int i = 0; i < n; i++) {
-      int fd = evs[i].data.fd;
-      if (fd == l->wakefd) {
+      uint64_t id64 = evs[i].data.u64;
+      if (id64 == WAKE_ID) {
         uint64_t tmp;
         ssize_t r = read(l->wakefd, &tmp, sizeof(tmp));
         (void)r;
         continue;
       }
+      int id = int(id64);
       if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
-        close_conn_locked(l, fd, true);
+        close_conn_locked(l, id, true);
         continue;
       }
-      if (evs[i].events & EPOLLIN) handle_readable(l, fd);
-      if (evs[i].events & EPOLLOUT) handle_writable(l, fd);
+      if (evs[i].events & EPOLLIN) handle_readable(l, id);
+      if (evs[i].events & EPOLLOUT) handle_writable(l, id);
     }
     // drain queued sends and closes from other threads
     while (!l->cmds.empty()) {
-      auto [fd, frame] = std::move(l->cmds.front());
+      auto [id, frame] = std::move(l->cmds.front());
       l->cmds.pop_front();
-      auto it = l->conns.find(fd);
+      auto it = l->conns.find(id);
       if (it == l->conns.end()) continue;
       it->second.wq.push_back(std::move(frame));
-      epoll_update(l, fd, true);
-      handle_writable(l, fd);
+      epoll_update(l, it->second, true);
+      if (!it->second.connecting) handle_writable(l, id);
     }
     while (!l->closing.empty()) {
-      int fd = l->closing.front();
+      int id = l->closing.front();
       l->closing.pop_front();
-      close_conn_locked(l, fd, false);
+      close_conn_locked(l, id, false);
     }
   }
   return nullptr;
@@ -245,28 +301,25 @@ void* cn_new() {
   l->wakefd = eventfd(0, EFD_NONBLOCK);
   epoll_event ev{};
   ev.events = EPOLLIN;
-  ev.data.fd = l->wakefd;
+  ev.data.u64 = WAKE_ID;
   epoll_ctl(l->epfd, EPOLL_CTL_ADD, l->wakefd, &ev);
   return l;
 }
 
 int cn_start(void* h) {
   Loop* l = static_cast<Loop*>(h);
-  l->running = true;
+  l->running.store(true, std::memory_order_release);
   return pthread_create(&l->thread, nullptr, loop_main, l) == 0 ? 0 : -1;
 }
 
 int cn_listen(void* h, const char* host, int port) {
   Loop* l = static_cast<Loop*>(h);
+  sockaddr_in addr;
+  if (!resolve_ipv4(host, port, /*passive=*/true, &addr)) return -1;
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
   int one = 1;
   setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
-  sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_port = htons(uint16_t(port));
-  addr.sin_addr.s_addr =
-      host && *host ? inet_addr(host) : htonl(INADDR_ANY);
   if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
       listen(fd, 128) < 0) {
     close(fd);
@@ -275,40 +328,45 @@ int cn_listen(void* h, const char* host, int port) {
   set_nonblock(fd);
   std::lock_guard<std::mutex> g(l->mu);
   Conn c;
+  c.id = l->next_id++;
   c.fd = fd;
   c.listener = true;
-  l->conns.emplace(fd, std::move(c));
-  epoll_event ev{};
-  ev.events = EPOLLIN;
-  ev.data.fd = fd;
-  epoll_ctl(l->epfd, EPOLL_CTL_ADD, fd, &ev);
-  return fd;
+  epoll_add(l, c, false);
+  int id = c.id;
+  l->conns.emplace(id, std::move(c));
+  return id;
 }
 
 int cn_connect(void* h, const char* host, int port) {
   Loop* l = static_cast<Loop*>(h);
+  sockaddr_in addr;
+  if (!resolve_ipv4(host, port, /*passive=*/false, &addr)) return -1;
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
-  sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_port = htons(uint16_t(port));
-  addr.sin_addr.s_addr = inet_addr(host && *host ? host : "127.0.0.1");
-  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
-    close(fd);
-    return -1;
-  }
   set_nonblock(fd);
-  int one = 1;
-  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  bool pending = false;
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    if (errno == EINPROGRESS) {
+      pending = true;  // completion (or failure) delivered via EPOLLOUT
+    } else {
+      close(fd);
+      return -1;
+    }
+  }
+  if (!pending) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  }
   std::lock_guard<std::mutex> g(l->mu);
   Conn c;
+  c.id = l->next_id++;
   c.fd = fd;
-  l->conns.emplace(fd, std::move(c));
-  epoll_event ev{};
-  ev.events = EPOLLIN;
-  ev.data.fd = fd;
-  epoll_ctl(l->epfd, EPOLL_CTL_ADD, fd, &ev);
-  return fd;
+  c.connecting = pending;
+  epoll_add(l, c, pending);
+  int id = c.id;
+  l->conns.emplace(id, std::move(c));
+  if (!pending) l->push_event(Event{id, ETYPE_CONNECT, 0, 0, {}});
+  return id;
 }
 
 int cn_send(void* h, int conn, uint8_t kind, uint64_t corr,
@@ -373,10 +431,10 @@ int cn_close_conn(void* h, int conn) {
 
 void cn_shutdown(void* h) {
   Loop* l = static_cast<Loop*>(h);
-  l->running = false;
+  l->running.store(false, std::memory_order_release);
   l->wake();
   pthread_join(l->thread, nullptr);
-  for (auto& [fd, c] : l->conns) close(fd);
+  for (auto& [id, c] : l->conns) close(c.fd);
   close(l->epfd);
   close(l->wakefd);
   delete l;

@@ -119,3 +119,35 @@ def test_asyncio_server_native_client_interop():
         finally:
             native.shutdown()
     run(main())
+
+
+def test_native_hostname_resolution():
+    """Hostnames (not just dotted quads) resolve via getaddrinfo."""
+    async def main():
+        transport = NativeTcpTransport()
+        try:
+            server = transport.server()
+            await server.listen(Address("localhost", PORT + 5), echo_handler)
+            conn = await transport.client().connect(
+                Address("localhost", PORT + 5))
+            assert await conn.send("named") == "echo:named"
+            await conn.close()
+            await server.close()
+        finally:
+            transport.shutdown()
+    run(main())
+
+
+def test_native_connect_refused_fails_fast():
+    """The connect itself is nonblocking in C (completion via epoll), but
+    the asyncio connect() awaits it — a refused connect raises there,
+    matching TcpTransport so failover loops keep working."""
+    async def main():
+        transport = NativeTcpTransport()
+        try:
+            with pytest.raises(TransportError):
+                await asyncio.wait_for(transport.client().connect(
+                    Address("127.0.0.1", PORT + 6)), 5)  # nothing listening
+        finally:
+            transport.shutdown()
+    run(main())
