@@ -92,9 +92,19 @@ class Submits(NamedTuple):
 
 class StepOutputs(NamedTuple):
     accepted: jnp.ndarray    # [G,S] bool — submit made it into the leader log
-    out_valid: jnp.ndarray   # [G,A] bool — a command applied at the leader
+    # Results are reported from the MOST-ADVANCED lane (argmax post-apply
+    # applied_index), not the leader lane: an entry applied during a
+    # leaderless round would otherwise never be reported (its result is
+    # not re-derivable later). Every entry is applied by that lane in the
+    # first round the global max applied_index passes it; re-reports from
+    # lanes catching up later are possible (at-least-once) — consumers
+    # dedup by tag (models/raft_groups.py _harvest pops _inflight).
+    out_valid: jnp.ndarray   # [G,A] bool — a command applied this round
     out_tag: jnp.ndarray     # [G,A] i32
     out_result: jnp.ndarray  # [G,A] i32
+    out_latency: jnp.ndarray  # [G,A] i32 rounds from log append to apply
+    #                           (commit latency in logical rounds —
+    #                           BASELINE.md p99 metric)
     leader: jnp.ndarray      # [G] i32 leader peer at round start (-1 none)
     commit_index: jnp.ndarray  # [G] i32 leader commit after the round
     stale: jnp.ndarray       # [G,P] bool — lagging beyond ring window
@@ -478,6 +488,14 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
     last_f = jnp.where(won, noop_idx, last2)
 
     # ---- phase 5: apply committed entries (all replicas, A per round) ----
+    # Reporting lane: the lane with the highest applied_index AFTER this
+    # round's budget (post = min(applied + A, commit)). In the first round
+    # the global max passes an entry, the argmax lane applies it (all lanes
+    # started below it), so every result is reported at least once — even
+    # when the group is leaderless (see StepOutputs docstring).
+    post_applied = jnp.minimum(state.applied_index + A, commit2)
+    rep = jnp.argmax(post_applied, axis=1).astype(jnp.int32)  # [G]
+
     # lax.scan keeps the compiled program one apply-kernel big, not A× big.
     def _apply_one(carry, _):
         resources, applied = carry
@@ -493,16 +511,18 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
         resources, result = apply_entry(
             resources, op_i, a_i, b_i, c_i, idx, time_i, do)
         applied = jnp.where(do, idx, applied)
-        lead_do = _peer_view(do, lead) & active
+        rep_do = _peer_view(do, rep)
         return (resources, applied), (
-            lead_do, jnp.where(lead_do, _peer_view(tag_i, lead), 0),
-            jnp.where(lead_do, _peer_view(result, lead), 0))
+            rep_do, jnp.where(rep_do, _peer_view(tag_i, rep), 0),
+            jnp.where(rep_do, _peer_view(result, rep), 0),
+            jnp.where(rep_do, l_clock - _peer_view(time_i, rep), 0))
 
-    (resources, applied), (ov, ot, orr) = jax.lax.scan(
+    (resources, applied), (ov, ot, orr, olat) = jax.lax.scan(
         _apply_one, (state.resources, state.applied_index), None, length=A)
     out_valid = jnp.moveaxis(ov, 0, 1)   # [A,G] -> [G,A]
     out_tag = jnp.moveaxis(ot, 0, 1)
     out_result = jnp.moveaxis(orr, 0, 1)
+    out_latency = jnp.moveaxis(olat, 0, 1)
 
     # ---- phase 6: drain session events (leader lane → host) --------------
     # Gated on an active leader so events emitted during leaderless rounds
@@ -521,7 +541,7 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
         log_tag=log_tag2, resources=resources)
     outputs = StepOutputs(
         accepted=accepted, out_valid=out_valid, out_tag=out_tag,
-        out_result=out_result, leader=lead,
+        out_result=out_result, out_latency=out_latency, leader=lead,
         commit_index=jnp.where(active, l_commit, jnp.max(commit2, axis=1)),
         stale=stale, clock=l_clock,
         ev_seq=_peer_view(ev_seq, lead), ev_code=_peer_view(ev_code, lead),

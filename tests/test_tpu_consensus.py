@@ -226,3 +226,22 @@ def test_sharded_over_mesh(mesh_kind):
     rg.run_until(tags)
     for g in range(4):
         assert rg.results[tags[g]] == g + 1
+
+
+def test_out_latency_tracks_append_to_apply_lag():
+    """out_latency = rounds an entry waited in the log before apply (0 when
+    the synchronous round replicates+commits+applies it immediately)."""
+    rg = make(groups=2, peers=3)
+    rg.wait_for_leaders()
+    tags = [rg.submit(0, ap.OP_LONG_ADD, 1) for _ in range(3)]
+    lats = []
+    for _ in range(30):
+        out = rg.step_round()
+        v = np.asarray(out.out_valid)
+        lats += list(np.asarray(out.out_latency)[v])
+        if all(t in rg.results for t in tags):
+            break
+    assert all(t in rg.results for t in tags)
+    assert lats, "no applied entries observed"
+    L = rg.log_slots
+    assert all(0 <= x <= L for x in lats), lats
