@@ -37,6 +37,7 @@ import numpy as np
 
 from copycat_tpu.ops import apply as ap
 from copycat_tpu.ops.apply import ResourceConfig
+from copycat_tpu.utils.profiling import xla_trace
 from copycat_tpu.ops.consensus import (
     Config,
     LEADER,
@@ -70,6 +71,10 @@ REPEATS = int(os.environ.get("COPYCAT_BENCH_REPEATS", "3"))
 SUBMIT_SLOTS = int(os.environ.get("COPYCAT_BENCH_SUBMIT_SLOTS", "16"))
 NORTH_STAR_OPS = 1_000_000.0
 USE_PALLAS = os.environ.get("COPYCAT_BENCH_PALLAS", "0") == "1"
+# Set to a directory to capture an XLA profiler trace of the first timed
+# repetition (open in TensorBoard/XProf, or summarize with
+# copycat_tpu.utils.profiling.summarize_trace).
+PROFILE_DIR = os.environ.get("COPYCAT_BENCH_PROFILE", "")
 
 
 def log(msg: str) -> None:
@@ -93,7 +98,7 @@ def current_leaders(state) -> jnp.ndarray:
     """[G] leader peer index per group, -1 if none (mirrors step())."""
     lead_term = jnp.where(state.role == LEADER, state.term, -1)
     lead = jnp.argmax(lead_term, axis=1).astype(jnp.int32)
-    active = jnp.take_along_axis(lead_term, lead[:, None], 1)[:, 0] >= 0
+    active = jnp.max(lead_term, axis=1) >= 0
     return jnp.where(active, lead, -1)
 
 
@@ -241,10 +246,11 @@ def run_throughput(scenario: str) -> dict:
     best, best_dt, best_hist = 0.0, 1.0, np.asarray(hist)
 
     for rep in range(REPEATS):
-        t0 = time.perf_counter()
-        state, key, n, hist = run_jit(state, key)
-        n = int(jax.block_until_ready(n))
-        dt = time.perf_counter() - t0
+        with xla_trace(PROFILE_DIR if rep == 0 else None):
+            t0 = time.perf_counter()
+            state, key, n, hist = run_jit(state, key)
+            n = int(jax.block_until_ready(n))
+            dt = time.perf_counter() - t0
         ops = n / dt
         if ops >= best:
             best, best_dt, best_hist = ops, dt, np.asarray(hist)
@@ -316,10 +322,11 @@ def run_election() -> dict:
 
     best = 0.0
     for rep in range(REPEATS):
-        t0 = time.perf_counter()
-        state, key, n = run_jit(state, key)
-        n = int(jax.block_until_ready(n))
-        dt = time.perf_counter() - t0
+        with xla_trace(PROFILE_DIR if rep == 0 else None):
+            t0 = time.perf_counter()
+            state, key, n = run_jit(state, key)
+            n = int(jax.block_until_ready(n))
+            dt = time.perf_counter() - t0
         rate = n / dt
         best = max(best, rate)
         log(f"bench[election]: rep {rep}: {n} elections in {dt:.3f}s "

@@ -230,8 +230,13 @@ def init_resources(num_groups: int, num_peers: int,
 # ---------------------------------------------------------------------------
 
 def _gather3(arr: jnp.ndarray, slot: jnp.ndarray) -> jnp.ndarray:
-    """arr[G,P,N] gathered at slot[G,P] -> [G,P]."""
-    return jnp.take_along_axis(arr, slot[..., None], axis=2).squeeze(-1)
+    """arr[G,P,N] selected at slot[G,P] -> [G,P].
+
+    One-hot select-reduce: take_along_axis lowers to an element-wise DMA
+    loop on TPU; the masked sum is one fused vector pass over the pool."""
+    N = arr.shape[-1]
+    oh = slot[..., None] == jnp.arange(N, dtype=jnp.int32)
+    return jnp.where(oh, arr, 0).sum(axis=-1).astype(arr.dtype)
 
 
 def _scatter3(arr: jnp.ndarray, slot: jnp.ndarray, mask: jnp.ndarray,
@@ -264,8 +269,12 @@ def _ring_compact(mask: jnp.ndarray, head, size, pos, live_arr, live_win,
     order = jnp.argsort(jnp.where(live_win, pos, N + pos), axis=-1)
     count = jnp.sum(live_win, axis=-1).astype(jnp.int32)
     m3 = mask[..., None]
-    out = [jnp.where(m3, jnp.take_along_axis(arr, order, axis=-1), arr)
-           for arr in arrays]
+    # permutation as a one-hot [G,P,N,N] select-reduce (N is small); the
+    # take_along_axis equivalent lowers to an element-wise DMA loop on TPU
+    perm = order[..., None] == jnp.arange(N, dtype=jnp.int32)
+    pick = lambda arr: jnp.where(perm, arr[..., None, :], 0).sum(-1).astype(
+        arr.dtype)
+    out = [jnp.where(m3, pick(arr), arr) for arr in arrays]
     live = jnp.where(m3, jnp.arange(N)[None, None, :] < count[..., None],
                      live_arr)
     head = jnp.where(mask, 0, head)

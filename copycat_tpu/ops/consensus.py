@@ -45,6 +45,7 @@ import jax.numpy as jnp
 from .apply import (
     ResourceConfig,
     ResourceState,
+    _gather3,
     apply_entry,
     drain_events,
     init_resources,
@@ -181,7 +182,8 @@ def _term_at_2d(log_term: jnp.ndarray, last: jnp.ndarray,
     """Term lookup on a [G,L] ring at idx [G,P] (0 outside the live window)."""
     L = log_term.shape[-1]
     slot = (idx - 1) % L
-    t = jnp.take_along_axis(log_term, slot, axis=1)
+    t = _gather3(jnp.broadcast_to(log_term[:, None, :],
+                                  idx.shape + (L,)), slot)
     valid = (idx >= 1) & (idx <= last[:, None]) & (idx > last[:, None] - L)
     return jnp.where(valid, t, 0)
 
@@ -190,8 +192,7 @@ def _term_at_own(log_term: jnp.ndarray, last: jnp.ndarray,
                  idx: jnp.ndarray) -> jnp.ndarray:
     """Term lookup on each replica's own [G,P,L] ring at idx [G,P]."""
     L = log_term.shape[-1]
-    slot = ((idx - 1) % L)[..., None]
-    t = jnp.take_along_axis(log_term, slot, axis=2).squeeze(-1)
+    t = _gather3(log_term, (idx - 1) % L)
     valid = (idx >= 1) & (idx <= last) & (idx > last - L)
     return jnp.where(valid, t, 0)
 
@@ -278,7 +279,7 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
     # leader simply stays silent until it learns the higher term) ----
     lead_term = jnp.where(state.role == LEADER, state.term, -1)
     lead = jnp.argmax(lead_term, axis=1).astype(jnp.int32)
-    active = jnp.take_along_axis(lead_term, lead[:, None], 1)[:, 0] >= 0
+    active = jnp.max(lead_term, axis=1) >= 0
     lead = jnp.where(active, lead, -1)
 
     l_term = _peer_view(state.term, lead)          # [G]

@@ -1,0 +1,80 @@
+"""XLA profiler integration (SURVEY.md §5.1 build obligation).
+
+The reference has no tracing beyond SLF4J loggers (§5.1 names the XLA
+profiler hook a "free win on TPU"). :func:`xla_trace` wraps any step-loop
+region in a ``jax.profiler`` trace whose artifacts open in
+TensorBoard/XProf (or parse with ``xprof.convert.raw_to_tool_data`` when no
+UI is available — that is how the one-hot rewrite in ``ops/consensus.py``
+was found; see PERF.md).
+
+Usage::
+
+    from copycat_tpu.utils.profiling import xla_trace
+
+    with xla_trace("/tmp/copycat-trace"):   # no-op when dir is falsy
+        for _ in range(5):
+            rg.step_round()
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def xla_trace(trace_dir: str | None) -> Iterator[None]:
+    """Trace the enclosed region with ``jax.profiler`` (no-op if falsy)."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(str(trace_dir)):
+        yield
+
+
+def summarize_trace(trace_dir: str, top: int = 15) -> list[tuple[str, float, int]]:
+    """Aggregate device-op time from the NEWEST captured trace session.
+
+    Returns ``[(op_name, total_ms, count), ...]`` sorted by time — enough
+    to find the hot op without a TensorBoard UI. Only events on device
+    (TPU/accelerator) lanes are counted, so host-side spans and module
+    wrappers don't drown the per-op numbers. Requires the ``xprof``
+    package (present in the image).
+    """
+    import collections
+    import glob
+    import json
+    import os
+
+    from xprof.convert import raw_to_tool_data as rtd
+
+    # jax.profiler.trace writes one timestamped session subdir per capture;
+    # summarize only the newest so reused trace dirs don't merge runs.
+    sessions = sorted(glob.glob(f"{trace_dir}/plugins/profile/*/"))
+    if not sessions:
+        raise FileNotFoundError(f"no profile sessions under {trace_dir}")
+    files = glob.glob(os.path.join(sessions[-1], "*.xplane.pb"))
+    data, _ = rtd.xspace_to_tool_data(files, "trace_viewer", {})
+    trace = json.loads(data.decode() if isinstance(data, bytes) else data)
+    events = trace["traceEvents"]
+
+    # Map pid -> process name from metadata events; keep device lanes only.
+    proc: dict = {}
+    for event in events:
+        if event.get("ph") == "M" and event.get("name") == "process_name":
+            proc[event.get("pid")] = event.get("args", {}).get("name", "")
+    device_pids = {pid for pid, name in proc.items()
+                   if any(t in name for t in ("TPU", "GPU", "/device",
+                                              "Device", "XLA Op"))}
+
+    agg: collections.Counter = collections.Counter()
+    cnt: collections.Counter = collections.Counter()
+    for event in events:
+        if event.get("ph") != "X" or event.get("pid") not in device_pids:
+            continue
+        name = event.get("name", "")
+        agg[name] += event.get("dur", 0)
+        cnt[name] += 1
+    return [(name, dur / 1e3, cnt[name]) for name, dur in agg.most_common(top)]
