@@ -52,11 +52,33 @@ class DeviceResource:
         # registers instead (OP_LOCK_HOLDER / OP_ELECT_LEADER fallbacks).
         evs = groups.events.get(group, [])
         self._ev_last = evs[-1][0] if evs else -1
+        # ATOMIC routes reads through the log (linearizable); SEQUENTIAL
+        # serves them from the leader's applied state on the query lane
+        # (no log append) — the reference's Consistency mapping
+        # (Consistency.java:60-176: ATOMIC→LINEARIZABLE reads,
+        # SEQUENTIAL/PROCESS→leader-served reads without consensus).
+        self.consistency = "atomic"
+
+    def with_consistency(self, level: str) -> "DeviceResource":
+        """Set the read consistency level ('atomic' | 'sequential');
+        chainable, mirroring ``Resource.with(Consistency)``."""
+        if level not in ("atomic", "sequential"):
+            raise ValueError(f"unknown consistency level {level!r}")
+        self.consistency = level
+        return self
 
     def _call(self, opcode: int, a: int = 0, b: int = 0, c: int = 0) -> int:
         tag = self._rg.submit(self._group, opcode, a, b, c)
         self._rg.run_until([tag])
         return self._rg.results.pop(tag)  # facade path stays bounded
+
+    def _read(self, opcode: int, a: int = 0, b: int = 0, c: int = 0) -> int:
+        """Route a read-only op by the configured consistency level."""
+        if self.consistency == "atomic":
+            return self._call(opcode, a, b, c)
+        tag = self._rg.submit_query(self._group, opcode, a, b, c)
+        self._rg.run_until([tag])
+        return self._rg.results.pop(tag)
 
     def _checked(self, *args) -> int:
         result = self._call(*args)
@@ -78,7 +100,7 @@ class DeviceValue(DeviceResource):
     """Linearizable int32 register (DistributedAtomicValue.java:38)."""
 
     def get(self) -> int:
-        return self._call(ops.OP_VALUE_GET)
+        return self._read(ops.OP_VALUE_GET)
 
     def set(self, value: int, ttl: int = 0) -> None:
         self._call(ops.OP_VALUE_SET, value, 0, ttl)
@@ -96,7 +118,7 @@ class DeviceLong(DeviceResource):
     the apply kernel is already atomic in log order."""
 
     def get(self) -> int:
-        return self._call(ops.OP_VALUE_GET)
+        return self._read(ops.OP_VALUE_GET)
 
     def add_and_get(self, delta: int = 1) -> int:
         return self._call(ops.OP_LONG_ADD, delta)
@@ -118,10 +140,10 @@ class DeviceMap(DeviceResource):
         return self._checked(ops.OP_MAP_PUT, key, _check_value(value), ttl)
 
     def get(self, key: int) -> int:
-        return self._call(ops.OP_MAP_GET, key)
+        return self._read(ops.OP_MAP_GET, key)
 
     def get_or_default(self, key: int, default: int) -> int:
-        return self._call(ops.OP_MAP_GET_OR_DEFAULT, key, default)
+        return self._read(ops.OP_MAP_GET_OR_DEFAULT, key, default)
 
     def put_if_absent(self, key: int, value: int, ttl: int = 0) -> bool:
         return bool(self._checked(ops.OP_MAP_PUT_IF_ABSENT, key,
@@ -142,16 +164,16 @@ class DeviceMap(DeviceResource):
                                _check_value(update)))
 
     def contains_key(self, key: int) -> bool:
-        return bool(self._call(ops.OP_MAP_CONTAINS_KEY, key))
+        return bool(self._read(ops.OP_MAP_CONTAINS_KEY, key))
 
     def contains_value(self, value: int) -> bool:
-        return bool(self._call(ops.OP_MAP_CONTAINS_VALUE, value))
+        return bool(self._read(ops.OP_MAP_CONTAINS_VALUE, value))
 
     def size(self) -> int:
-        return self._call(ops.OP_MAP_SIZE)
+        return self._read(ops.OP_MAP_SIZE)
 
     def is_empty(self) -> bool:
-        return bool(self._call(ops.OP_MAP_IS_EMPTY))
+        return bool(self._read(ops.OP_MAP_IS_EMPTY))
 
     def clear(self) -> None:
         self._call(ops.OP_MAP_CLEAR)
@@ -168,10 +190,10 @@ class DeviceSet(DeviceResource):
         return bool(self._call(ops.OP_SET_REMOVE, value))
 
     def contains(self, value: int) -> bool:
-        return bool(self._call(ops.OP_SET_CONTAINS, value))
+        return bool(self._read(ops.OP_SET_CONTAINS, value))
 
     def size(self) -> int:
-        return self._call(ops.OP_SET_SIZE)
+        return self._read(ops.OP_SET_SIZE)
 
     def is_empty(self) -> bool:
         return self.size() == 0
@@ -195,11 +217,11 @@ class DeviceQueue(DeviceResource):
         return None if result == FAIL else result
 
     def peek(self) -> int | None:
-        result = self._call(ops.OP_Q_PEEK)
+        result = self._read(ops.OP_Q_PEEK)
         return None if result == FAIL else result
 
     def size(self) -> int:
-        return self._call(ops.OP_Q_SIZE)
+        return self._read(ops.OP_Q_SIZE)
 
     def is_empty(self) -> bool:
         return self.size() == 0

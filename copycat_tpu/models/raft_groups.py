@@ -31,6 +31,7 @@ from ..ops.consensus import (
     full_delivery,
     init_state,
     install_snapshots,
+    query_step,
     step,
 )
 
@@ -69,8 +70,10 @@ class RaftGroups:
                 self._empty_submits(), self.deliver, mesh)
 
         self._step = jax.jit(partial(step, config=self.config))
+        self._query = jax.jit(partial(query_step, config=self.config))
         self._install = jax.jit(partial(install_snapshots, config=self.config))
         self._queues: dict[int, deque] = {}
+        self._query_queues: dict[int, deque] = {}
         self._next_tag = 1
         self._inflight: dict[int, tuple[int, int]] = {}  # tag -> (group, round)
         self.results: dict[int, int] = {}    # tag -> result
@@ -105,11 +108,34 @@ class RaftGroups:
         self.metrics.counter("ops_submitted").inc()
         return tag
 
-    def _build_submits(self) -> Submits:
-        sub = self._empty_submits()
-        if not self._queues:
-            return sub
-        for g, q in list(self._queues.items()):
+    def submit_query(self, group: int, opcode: int, a: int = 0, b: int = 0,
+                     c: int = 0) -> int:
+        """Queue a read-only op on the fast query lane (no log append).
+
+        Served from the leader's applied state at SEQUENTIAL consistency
+        (the reference's sub-ATOMIC query routing, ``Consistency.java``);
+        escalates to the command path automatically when no current leader
+        can serve it. Resolves in ``results`` like :meth:`submit`."""
+        from ..ops.apply import QUERY_OPCODES
+        if opcode not in QUERY_OPCODES:
+            # query_step discards state: a write here would be silently
+            # dropped while acking success (reference rejects them too)
+            raise ValueError(
+                f"opcode {opcode} is not read-only; submit it as a command")
+        tag = self._next_tag
+        self._next_tag += 1
+        self._query_queues.setdefault(group, deque()).append(
+            (opcode, a, b, c, tag))
+        self._inflight[tag] = (group, self.rounds)
+        self.metrics.counter("queries_submitted").inc()
+        return tag
+
+    def _drain_into(self, queues: dict[int, deque],
+                    sub: Submits) -> list[tuple[int, int]]:
+        """Pop up to ``submit_slots`` queued ops per group into ``sub``;
+        returns the (group, slot) pairs filled."""
+        placed: list[tuple[int, int]] = []
+        for g, q in list(queues.items()):
             for s in range(self.submit_slots):
                 if not q:
                     break
@@ -120,8 +146,15 @@ class RaftGroups:
                 sub.c[g, s] = c
                 sub.tag[g, s] = tag
                 sub.valid[g, s] = True
+                placed.append((g, s))
             if not q:
-                del self._queues[g]
+                del queues[g]
+        return placed
+
+    def _build_submits(self) -> Submits:
+        sub = self._empty_submits()
+        if self._queues:
+            self._drain_into(self._queues, sub)
         return sub
 
     # -- stepping ----------------------------------------------------------
@@ -143,12 +176,39 @@ class RaftGroups:
         if not explicit:
             self._requeue_rejected(submits, out)
         self._harvest(out)
+        if self._query_queues:
+            self._serve_queries()
         # Followers lagging beyond the ring window can't be served by
         # AppendEntries: install a snapshot of the leader's lane (log ring +
         # applied resource state) so they reconverge.
         if bool(np.asarray(out.stale).any()):
             self.state = self._install(self.state, out.stale, out.leader)
         return out
+
+    def _serve_queries(self) -> None:
+        """Drain the query lane: serve from the leader's applied state; a
+        slot the device can't serve (leaderless group, applied < commit)
+        escalates to the command path — same consistency, one log entry."""
+        sub = self._empty_submits()
+        placed = self._drain_into(self._query_queues, sub)
+        results, served = self._query(self.state, sub)
+        results = np.asarray(results)
+        served = np.asarray(served)
+        fell_back = self.metrics.counter("queries_escalated")
+        done = self.metrics.counter("queries_served")
+        for g, s in placed:
+            tag = int(sub.tag[g, s])
+            if served[g, s]:
+                if tag in self._inflight:
+                    self._inflight.pop(tag)
+                    self.results[tag] = int(results[g, s])
+                    done.inc()
+            else:
+                # escalate: re-enter as a command (quorum-committed read)
+                self._queues.setdefault(g, deque()).append(
+                    (int(sub.opcode[g, s]), int(sub.a[g, s]),
+                     int(sub.b[g, s]), int(sub.c[g, s]), tag))
+                fell_back.inc()
 
     def _requeue_rejected(self, submits: Submits, out: StepOutputs) -> None:
         acc = np.asarray(out.accepted)

@@ -111,6 +111,19 @@ OP_ELECT_IS_LEADER = 62   # a=candidate id, b=epoch -> 0/1 (fencing check)
 OP_ELECT_LEADER = 63      # -> current leader id | -1 (authoritative)
 OP_ELECT_GET_EPOCH = 64   # -> current epoch
 
+# Read-only opcodes servable on the fast query lane (query_step evaluates
+# and DISCARDS state, so admitting a write there would silently drop the
+# mutation while acking success — the host validates against this set).
+QUERY_OPCODES = frozenset({
+    OP_VALUE_GET,
+    OP_MAP_GET, OP_MAP_GET_OR_DEFAULT, OP_MAP_CONTAINS_KEY,
+    OP_MAP_CONTAINS_VALUE, OP_MAP_SIZE, OP_MAP_IS_EMPTY,
+    OP_SET_CONTAINS, OP_SET_SIZE,
+    OP_Q_PEEK, OP_Q_SIZE,
+    OP_LOCK_HOLDER,
+    OP_ELECT_IS_LEADER, OP_ELECT_LEADER, OP_ELECT_GET_EPOCH,
+})
+
 # --- event codes (session push, harvested from the leader lane) ------------
 EV_NONE = 0
 EV_LOCK_GRANT = 1   # target=holder id, arg=1

@@ -252,6 +252,68 @@ def install_snapshots(state: RaftState, stale: jnp.ndarray,
     )
 
 
+def current_leader(state: RaftState) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-group leader lane and whether one exists: ``(lead [G], active
+    [G])``. The highest-term LEADER lane wins; a stale lower-term leader
+    stays silent until it learns the higher term."""
+    lead_term = jnp.where(state.role == LEADER, state.term, -1)
+    lead = jnp.argmax(lead_term, axis=1).astype(jnp.int32)
+    active = jnp.max(lead_term, axis=1) >= 0
+    return jnp.where(active, lead, -1), active
+
+
+def query_step(state: RaftState, queries: Submits,
+               config: Config = Config()) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Serve read-only ops from the leader's applied state — no log append.
+
+    The reference serves CAUSAL/SEQUENTIAL queries without consensus
+    (``Consistency.java:45-126``: only ATOMIC reads pay for quorum); the
+    CPU oracle routes the same way (``server/raft.py`` query routing).
+    This is the device equivalent: a separate tiny program (no state
+    output — nothing is written back) that evaluates query opcodes against
+    the leader lane's resource pools. Serving is gated on the lane being a
+    current leader that (a) has applied everything it committed AND (b)
+    has committed an entry of its OWN term — a freshly elected leader's
+    commit index can trail its predecessor's served state until its
+    election no-op commits (Raft §8), and serving before that could hand a
+    client state older than a read it already observed. With the gate,
+    reads are sequential: leader-local and monotone per group. ATOMIC
+    reads keep the full log path.
+
+    Returns ``(results [G,S], served [G,S] bool)`` — unserved slots (no
+    leader, fresh leader, or applied < commit) must be retried or
+    escalated to the command path by the caller (models/raft_groups.py
+    does the latter).
+    """
+    G = state.term.shape[0]
+    S = queries.valid.shape[1]
+    lead, active = current_leader(state)
+    l_applied = _peer_view(state.applied_index, lead)
+    l_commit = _peer_view(state.commit_index, lead)
+    l_term = _peer_view(state.term, lead)
+    l_last = _peer_view(state.last_index, lead)
+    l_log_term = _peer_view(state.log_term, lead)
+    commit_term = _term_at_2d(l_log_term, l_last, l_commit[:, None])[:, 0]
+    current = active & (l_applied >= l_commit) & (commit_term == l_term)
+    served = queries.valid & current[:, None]
+
+    # Leader-lane view of every pool, broadcast over the S query slots so
+    # the shape-generic apply kernel evaluates ALL slots in one fused pass
+    # (the broadcast is a view — reads never materialize [G,S,...] pools).
+    lres = jax.tree.map(
+        lambda x: jnp.broadcast_to(
+            _peer_view(x, lead)[:, None], (G, S) + x.shape[2:]),
+        state.resources)
+    now = jnp.broadcast_to(_peer_view(state.clock, lead)[:, None], (G, S))
+
+    # Read-only evaluation: the returned (possibly TTL-purged) state is
+    # discarded, so the replicated pools are never perturbed.
+    _, results = apply_entry(
+        lres, queries.opcode, queries.a, queries.b, queries.c,
+        jnp.zeros_like(queries.opcode), now, served)
+    return jnp.where(served, results, 0), served
+
+
 # ---------------------------------------------------------------------------
 # the step
 # ---------------------------------------------------------------------------
@@ -275,12 +337,7 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
     # Self-delivery is always on (a node talks to itself).
     deliver = deliver | jnp.eye(P, dtype=bool)[None]
 
-    # ---- current leader per group (highest-term leader wins; a stale
-    # leader simply stays silent until it learns the higher term) ----
-    lead_term = jnp.where(state.role == LEADER, state.term, -1)
-    lead = jnp.argmax(lead_term, axis=1).astype(jnp.int32)
-    active = jnp.max(lead_term, axis=1) >= 0
-    lead = jnp.where(active, lead, -1)
+    lead, active = current_leader(state)
 
     l_term = _peer_view(state.term, lead)          # [G]
     l_last = _peer_view(state.last_index, lead)    # [G]

@@ -8,6 +8,7 @@ import pytest
 
 jax = pytest.importorskip("jax")
 
+from copycat_tpu.ops import apply as ap  # noqa: E402
 from copycat_tpu.models import (  # noqa: E402
     DeviceElection,
     DeviceLock,
@@ -131,3 +132,62 @@ def test_election_facade():
     assert e2.poll_elected() is not None
     assert e2.is_leader()
     assert not e1.is_leader(epoch1)  # stale fencing token rejected
+
+
+def test_sequential_reads_via_query_lane():
+    """SEQUENTIAL reads are served from the leader's applied state (no log
+    append): committed writes are visible and the log does not grow."""
+    import numpy as np
+    groups = RaftGroups(2, 3, log_slots=64)
+    groups.wait_for_leaders()
+    m = DeviceMap(groups, 0).with_consistency("sequential")
+    v = DeviceValue(groups, 1).with_consistency("sequential")
+    m.put(3, 33)
+    v.set(77)
+    last_before = int(np.asarray(groups.state.last_index[0]).max())
+    assert m.get(3) == 33
+    assert m.get_or_default(9, 42) == 42
+    assert m.contains_key(3) and not m.contains_key(9)
+    assert m.size() == 1
+    assert v.get() == 77
+    last_after = int(np.asarray(groups.state.last_index[0]).max())
+    assert last_after == last_before  # reads appended nothing
+    assert groups.metrics.counter("queries_served").value >= 5
+
+
+def test_query_lane_escalates_without_leader():
+    """A query submitted before any leader exists cannot be served from
+    applied state; it falls back to the command path and resolves through
+    the log once a leader is elected (queries are never silently
+    dropped — reference routes every query to a leader)."""
+    groups = RaftGroups(1, 3, log_slots=64)
+    assert groups.leader(0) == -1  # pre-election: genuinely leaderless
+    tag = groups.submit_query(0, ap.OP_VALUE_GET)
+    groups.step_round()  # query lane attempts + escalates
+    assert groups.metrics.counter("queries_escalated").value >= 1
+    groups.run_until([tag])  # election happens, command path serves it
+    assert groups.results[tag] == 0
+
+
+def test_sequential_reads_are_monotone():
+    """Mixed read/write history: query-lane reads of a counter never go
+    backwards (sequential consistency on one session)."""
+    groups = RaftGroups(1, 3, log_slots=64)
+    groups.wait_for_leaders()
+    counter = DeviceLong(groups, 0)
+    reader = DeviceLong(groups, 0).with_consistency("sequential")
+    seen = 0
+    for _ in range(10):
+        counter.add_and_get(1)
+        got = reader.get()
+        assert got >= seen, f"read went backwards: {got} < {seen}"
+        seen = got
+    assert seen == 10  # quiesced: all committed increments visible
+
+
+def test_query_lane_rejects_write_opcodes():
+    """The query lane discards state, so writes must be rejected up front
+    (a put 'served' there would be silently dropped with a success ack)."""
+    groups = RaftGroups(1, 3, log_slots=64)
+    with pytest.raises(ValueError, match="not read-only"):
+        groups.submit_query(0, ap.OP_MAP_PUT, 1, 2)
