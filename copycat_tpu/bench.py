@@ -1,0 +1,419 @@
+"""Headline benchmark: committed linearizable ops/sec over batched Raft groups.
+
+BASELINE.md metric: "committed ops/sec over 10k Raft groups". The reference
+publishes no numbers (BASELINE.md §published — absence verified), so
+``vs_baseline`` is reported against the BASELINE.json north-star target of
+1M linearizable ops/sec.
+
+Prints ONE JSON line on stdout; all diagnostics go to stderr.
+
+Scenarios (``COPYCAT_BENCH_SCENARIO``, BASELINE.md benchmark configs):
+
+- ``counter`` (default, config #1 scaled out): every submit slot carries a
+  ``DistributedLong.addAndGet``; G groups × 3 peers; R rounds under
+  ``lax.scan``. Each committed entry is a quorum-replicated, leader-applied
+  linearizable command.
+- ``election`` (config #2): 1k groups; a random peer is isolated every few
+  rounds (device-side nemesis masks), forcing re-elections; measures
+  elections completed/sec (batched RequestVote tally path).
+- ``map`` (config #3): put/get mix through the hashed map apply kernel.
+- ``lock`` (config #4): acquire→queue→release→grant chains in every group
+  (event-push grant path).
+- ``mixed`` (config #5): counter+map+lock mix with per-round random peer
+  isolation (nemesis) across all groups.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from copycat_tpu.ops import apply as ap
+from copycat_tpu.ops.apply import ResourceConfig
+from copycat_tpu.utils.profiling import xla_trace
+from copycat_tpu.ops.consensus import (
+    Config,
+    Submits,
+    current_leader,
+    full_delivery,
+    init_state,
+    make_submits,
+    query_step,
+    step,
+)
+
+# Pool state is carried through every step (HBM traffic), so each scenario
+# compiles in only the pools its groups actually host (ResourceConfig
+# zero-size pools are compiled out of the kernel).
+RESOURCE_CONFIGS = {
+    "counter": ResourceConfig.counters_only(),
+    "election": ResourceConfig.counters_only(),
+    "map": ResourceConfig(set_slots=0, queue_slots=0, wait_slots=0,
+                          listener_slots=0, event_slots=0),
+    "lock": ResourceConfig(map_slots=0, set_slots=0, queue_slots=0,
+                           listener_slots=0),
+    "mixed": ResourceConfig(set_slots=0, queue_slots=0, listener_slots=0),
+}
+
+SCENARIO = os.environ.get("COPYCAT_BENCH_SCENARIO", "counter")
+GROUPS = int(os.environ.get(
+    "COPYCAT_BENCH_GROUPS", "1000" if SCENARIO == "election" else "10000"))
+PEERS = int(os.environ.get("COPYCAT_BENCH_PEERS", "3"))
+LOG_SLOTS = int(os.environ.get("COPYCAT_BENCH_LOG_SLOTS", "64"))
+ROUNDS = int(os.environ.get("COPYCAT_BENCH_ROUNDS", "200"))
+REPEATS = int(os.environ.get("COPYCAT_BENCH_REPEATS", "3"))
+SUBMIT_SLOTS = int(os.environ.get("COPYCAT_BENCH_SUBMIT_SLOTS", "16"))
+NORTH_STAR_OPS = 1_000_000.0
+USE_PALLAS = os.environ.get("COPYCAT_BENCH_PALLAS", "0") == "1"
+# Set to a directory to capture an XLA profiler trace of the first timed
+# repetition (open in TensorBoard/XProf, or summarize with
+# copycat_tpu.utils.profiling.summarize_trace).
+PROFILE_DIR = os.environ.get("COPYCAT_BENCH_PROFILE", "")
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def percentiles(hist: np.ndarray, qs) -> list[int]:
+    """Percentile values from an exact count histogram (index = value)."""
+    total = int(hist.sum())
+    if total == 0:
+        return [0 for _ in qs]
+    cum = np.cumsum(hist)
+    return [int(np.searchsorted(cum, q * total)) for q in qs]
+
+
+def empty_submits(G: int) -> Submits:
+    return make_submits(G, SUBMIT_SLOTS)
+
+
+def current_leaders(state) -> jnp.ndarray:
+    """[G] leader peer index per group, -1 if none."""
+    return current_leader(state)[0]
+
+
+def tile_pattern(pattern, G: int) -> jnp.ndarray:
+    """Tile a short per-slot pattern across [G, SUBMIT_SLOTS]."""
+    pat = jnp.asarray(pattern, jnp.int32)
+    row = pat[jnp.arange(SUBMIT_SLOTS) % pat.size]
+    return jnp.broadcast_to(row, (G, SUBMIT_SLOTS))
+
+
+def counter_submits(G: int) -> Submits:
+    ones = jnp.ones((G, SUBMIT_SLOTS), jnp.int32)
+    return Submits(opcode=ones * ap.OP_LONG_ADD, a=ones, b=ones * 0,
+                   c=ones * 0, tag=ones, valid=ones.astype(bool))
+
+
+def map_submits(G: int) -> Submits:
+    """put/put/get/get over rotating keys (hashed-keyspace kernel)."""
+    ones = jnp.ones((G, SUBMIT_SLOTS), jnp.int32)
+    opc = [ap.OP_MAP_PUT, ap.OP_MAP_PUT, ap.OP_MAP_GET, ap.OP_MAP_GET]
+    keys = [1, 2, 1, 2]
+    return Submits(opcode=tile_pattern(opc, G), a=tile_pattern(keys, G),
+                   b=ones * 7, c=ones * 0, tag=ones,
+                   valid=ones.astype(bool))
+
+
+def lock_submits(G: int) -> Submits:
+    """acquire(1) → acquire(2, queued) → release(1) [grants 2] → release(2).
+
+    Every round drives the full grant chain including the event-push path.
+    """
+    ones = jnp.ones((G, SUBMIT_SLOTS), jnp.int32)
+    opc = [ap.OP_LOCK_ACQUIRE, ap.OP_LOCK_ACQUIRE,
+           ap.OP_LOCK_RELEASE, ap.OP_LOCK_RELEASE]
+    who = [1, 2, 1, 2]
+    waitflag = [-1, -1, 0, 0]
+    return Submits(opcode=tile_pattern(opc, G), a=tile_pattern(who, G),
+                   b=tile_pattern(waitflag, G),
+                   c=ones * 0, tag=ones, valid=ones.astype(bool))
+
+
+def mixed_submits(G: int) -> Submits:
+    ones = jnp.ones((G, SUBMIT_SLOTS), jnp.int32)
+    opc = [ap.OP_LONG_ADD, ap.OP_MAP_PUT,
+           ap.OP_LOCK_ACQUIRE, ap.OP_LOCK_RELEASE]
+    a = [1, 3, 9, 9]
+    b = [0, 5, -1, 0]
+    return Submits(opcode=tile_pattern(opc, G), a=tile_pattern(a, G),
+                   b=tile_pattern(b, G),
+                   c=ones * 0, tag=ones, valid=ones.astype(bool))
+
+
+SUBMIT_BUILDERS = {
+    "counter": counter_submits,
+    "map": map_submits,
+    "lock": lock_submits,
+    "mixed": mixed_submits,
+}
+
+
+def isolation_masks(rounds: int, G: int, P: int, period: int,
+                    seed: int) -> jnp.ndarray:
+    """Per-round victim peer per group (-1 = no fault), [R, G] int32."""
+    rng = np.random.default_rng(seed)
+    victims = np.full((rounds, G), -1, np.int32)
+    for r in range(0, rounds, period):
+        victims[r: r + period // 2] = rng.integers(0, P, G, dtype=np.int32)
+    return jnp.asarray(victims)
+
+
+def victim_deliver(victim: jnp.ndarray, G: int, P: int) -> jnp.ndarray:
+    """deliver[G,P,P] isolating ``victim[G]`` (-1 = fully connected)."""
+    peers = jnp.arange(P)
+    hit = peers[None, :] == victim[:, None]          # [G,P]
+    cut = hit[:, :, None] | hit[:, None, :]
+    return ~cut | (victim[:, None, None] < 0)
+
+
+def elect_all(state, jit_step, empty, deliver, key, G):
+    t0 = time.perf_counter()
+    for r in range(150):
+        key, k = jax.random.split(key)
+        state, out = jit_step(state, empty, deliver, k)
+        if int((np.asarray(out.leader) >= 0).sum()) == G:
+            break
+    else:
+        raise RuntimeError("not all groups elected a leader")
+    log(f"bench: all {G} leaders elected in {r + 1} rounds "
+        f"({time.perf_counter() - t0:.1f}s incl. compile)")
+    return state, key
+
+
+def run_throughput(scenario: str) -> dict:
+    config = Config(use_pallas=USE_PALLAS,
+                    append_window=max(4, SUBMIT_SLOTS),
+                    applies_per_round=max(4, SUBMIT_SLOTS),
+                    resource=RESOURCE_CONFIGS.get(scenario, ResourceConfig()))
+    key = jax.random.PRNGKey(0)
+    key, init_key = jax.random.split(key)
+    state = init_state(GROUPS, PEERS, LOG_SLOTS, init_key, config)
+    deliver = full_delivery(GROUPS, PEERS)
+    submits = SUBMIT_BUILDERS[scenario](GROUPS)
+    jit_step = jax.jit(partial(step, config=config))
+
+    log(f"bench[{scenario}]: G={GROUPS} P={PEERS} L={LOG_SLOTS} "
+        f"rounds={ROUNDS} device={jax.devices()[0].platform}")
+    state, key = elect_all(state, jit_step, empty_submits(GROUPS), deliver,
+                           key, GROUPS)
+
+    nemesis = scenario == "mixed"
+    victims = (isolation_masks(ROUNDS, GROUPS, PEERS, period=20, seed=1)
+               if nemesis else None)
+
+    # Commit latency (BASELINE.md metric): rounds from leader log append to
+    # apply, histogrammed on device. Under nemesis an entry can wait out an
+    # isolation window beyond the ring size, so leave headroom past L; the
+    # top bucket is a saturation catch-all (warned about below if hit).
+    max_lat = LOG_SLOTS + 34
+
+    def run(state, key):
+        def body(carry, victim):
+            state, key, applied_prev = carry
+            key, k = jax.random.split(key)
+            dl = (victim_deliver(victim, GROUPS, PEERS) if nemesis
+                  else deliver)
+            state, out = step(state, submits, dl, k, config=config)
+            lat = jnp.clip(out.out_latency.reshape(-1), 0, max_lat - 1)
+            hist = jnp.zeros(max_lat, jnp.int32).at[lat].add(
+                out.out_valid.reshape(-1).astype(jnp.int32))
+            # exact-once committed-op count: global applied high-water delta
+            # (out_valid reports are at-least-once across leader changes)
+            applied_now = jnp.max(state.applied_index, axis=1)
+            n = jnp.sum(applied_now - applied_prev, dtype=jnp.int32)
+            return (state, key, applied_now), (n, hist)
+        applied0 = jnp.max(state.applied_index, axis=1)
+        (state, key, _), (counts, hists) = jax.lax.scan(
+            body, (state, key, applied0), victims,
+            length=None if nemesis else ROUNDS)
+        return state, key, counts.sum(), hists.sum(axis=0)
+
+    run_jit = jax.jit(run)
+    state, key, n, hist = run_jit(state, key)
+    jax.block_until_ready(n)
+    log(f"bench[{scenario}]: warmup committed {int(n)} ops")
+    best, best_dt, best_hist = 0.0, 1.0, np.asarray(hist)
+
+    for rep in range(REPEATS):
+        with xla_trace(PROFILE_DIR if rep == 0 else None):
+            t0 = time.perf_counter()
+            state, key, n, hist = run_jit(state, key)
+            n = int(jax.block_until_ready(n))
+            dt = time.perf_counter() - t0
+        ops = n / dt
+        if ops >= best:
+            best, best_dt, best_hist = ops, dt, np.asarray(hist)
+        log(f"bench[{scenario}]: rep {rep}: {n} committed ops in {dt:.3f}s "
+            f"-> {ops:,.0f} ops/sec ({dt / ROUNDS * 1e3:.2f} ms/round)")
+    if best_hist[-1]:
+        log(f"bench[{scenario}]: WARNING: {int(best_hist[-1])} samples "
+            f"saturated the top latency bucket (>{max_lat - 1} rounds); "
+            f"p99 is a lower bound")
+
+    ms_per_round = best_dt / ROUNDS * 1e3
+    # out_latency counts rounds the entry sat in the log before apply; the
+    # round that appended+replicated+applied it counts too (+1): an op
+    # submitted before round r completes after round r finishes.
+    p50_r, p99_r = [p + 1 for p in percentiles(best_hist, (0.50, 0.99))]
+    log(f"bench[{scenario}]: commit latency p50={p50_r} rounds "
+        f"({p50_r * ms_per_round:.2f} ms)  p99={p99_r} rounds "
+        f"({p99_r * ms_per_round:.2f} ms) at {ms_per_round:.2f} ms/round")
+
+    suffix = "" if scenario == "counter" else f"_{scenario}"
+    return {
+        "metric": (f"committed_linearizable_ops_per_sec_{GROUPS}_groups"
+                   f"{suffix}"),
+        "value": round(best, 1),
+        "unit": "ops/sec",
+        "vs_baseline": round(best / NORTH_STAR_OPS, 4),
+        "p50_commit_latency_ms": round(p50_r * ms_per_round, 3),
+        "p99_commit_latency_ms": round(p99_r * ms_per_round, 3),
+        "p50_commit_latency_rounds": int(p50_r),
+        "p99_commit_latency_rounds": int(p99_r),
+    }
+
+
+def run_election() -> dict:
+    """Config #2: forced leader churn; measures elections completed/sec."""
+    config = Config(use_pallas=USE_PALLAS,
+                    resource=RESOURCE_CONFIGS["election"])
+    key = jax.random.PRNGKey(0)
+    key, init_key = jax.random.split(key)
+    state = init_state(GROUPS, PEERS, LOG_SLOTS, init_key, config)
+    deliver = full_delivery(GROUPS, PEERS)
+    empty = empty_submits(GROUPS)
+    jit_step = jax.jit(partial(step, config=config))
+
+    log(f"bench[election]: G={GROUPS} P={PEERS} rounds={ROUNDS} "
+        f"device={jax.devices()[0].platform}")
+    state, key = elect_all(state, jit_step, empty, deliver, key, GROUPS)
+    victims = isolation_masks(ROUNDS, GROUPS, PEERS, period=15, seed=2)
+
+    def run(state, key):
+        def body(carry, victim):
+            state, key, prev = carry
+            key, k = jax.random.split(key)
+            dl = victim_deliver(victim, GROUPS, PEERS)
+            state, out = step(state, empty, dl, k, config=config)
+            changed = ((out.leader >= 0) & (out.leader != prev)).sum(
+                dtype=jnp.int32)
+            return (state, key, out.leader), changed
+        # seed prev with the REAL current leaders so settled groups don't
+        # count as spurious elections in the first round
+        init = (state, key, current_leaders(state))
+        (state, key, _), changes = jax.lax.scan(body, init, victims)
+        return state, key, changes.sum()
+
+    run_jit = jax.jit(run)
+    state, key, n = run_jit(state, key)
+    jax.block_until_ready(n)
+    log(f"bench[election]: warmup saw {int(n)} leader changes")
+
+    best = 0.0
+    for rep in range(REPEATS):
+        with xla_trace(PROFILE_DIR if rep == 0 else None):
+            t0 = time.perf_counter()
+            state, key, n = run_jit(state, key)
+            n = int(jax.block_until_ready(n))
+            dt = time.perf_counter() - t0
+        rate = n / dt
+        best = max(best, rate)
+        log(f"bench[election]: rep {rep}: {n} elections in {dt:.3f}s "
+            f"-> {rate:,.0f} elections/sec")
+
+    return {
+        "metric": f"elections_per_sec_{GROUPS}_groups_under_nemesis",
+        "value": round(best, 1),
+        "unit": "elections/sec",
+        "vs_baseline": round(best / NORTH_STAR_OPS, 4),
+    }
+
+
+def run_map_read() -> dict:
+    """Config #3 variant, get-heavy: puts ride the log, gets ride the
+    query lane (leader-served SEQUENTIAL reads, no log append) — the
+    reference's sub-ATOMIC query routing at batch scale."""
+    config = Config(use_pallas=USE_PALLAS, append_window=max(4, SUBMIT_SLOTS),
+                    applies_per_round=max(4, SUBMIT_SLOTS),
+                    resource=RESOURCE_CONFIGS["map"])
+    key = jax.random.PRNGKey(0)
+    key, init_key = jax.random.split(key)
+    state = init_state(GROUPS, PEERS, LOG_SLOTS, init_key, config)
+    deliver = full_delivery(GROUPS, PEERS)
+    ones = jnp.ones((GROUPS, SUBMIT_SLOTS), jnp.int32)
+    puts = Submits(opcode=ones * ap.OP_MAP_PUT, a=tile_pattern([1, 2], GROUPS),
+                   b=ones * 7, c=ones * 0, tag=ones, valid=ones.astype(bool))
+    gets = Submits(opcode=ones * ap.OP_MAP_GET, a=tile_pattern([1, 2], GROUPS),
+                   b=ones * 0, c=ones * 0, tag=ones, valid=ones.astype(bool))
+    jit_step = jax.jit(partial(step, config=config))
+
+    log(f"bench[map_read]: G={GROUPS} P={PEERS} rounds={ROUNDS} "
+        f"{SUBMIT_SLOTS} puts (log) + {SUBMIT_SLOTS} gets (query lane) "
+        f"per group per round; device={jax.devices()[0].platform}")
+    state, key = elect_all(state, jit_step, empty_submits(GROUPS), deliver,
+                           key, GROUPS)
+
+    def run(state, key):
+        def body(carry, _):
+            state, key, applied_prev = carry
+            key, k = jax.random.split(key)
+            state, _ = step(state, puts, deliver, k, config=config)
+            _, served = query_step(state, gets, config=config)
+            applied_now = jnp.max(state.applied_index, axis=1)
+            n = jnp.sum(applied_now - applied_prev, dtype=jnp.int32) \
+                + served.sum(dtype=jnp.int32)
+            return (state, key, applied_now), n
+        applied0 = jnp.max(state.applied_index, axis=1)
+        (state, key, _), counts = jax.lax.scan(
+            body, (state, key, applied0), None, length=ROUNDS)
+        return state, key, counts.sum()
+
+    run_jit = jax.jit(run)
+    state, key, n = run_jit(state, key)
+    jax.block_until_ready(n)
+    log(f"bench[map_read]: warmup completed {int(n)} ops")
+
+    best = 0.0
+    for rep in range(REPEATS):
+        with xla_trace(PROFILE_DIR if rep == 0 else None):
+            t0 = time.perf_counter()
+            state, key, n = run_jit(state, key)
+            n = int(jax.block_until_ready(n))
+            dt = time.perf_counter() - t0
+        ops = n / dt
+        best = max(best, ops)
+        log(f"bench[map_read]: rep {rep}: {n} ops in {dt:.3f}s "
+            f"-> {ops:,.0f} ops/sec ({dt / ROUNDS * 1e3:.2f} ms/round)")
+
+    return {
+        "metric": (f"map_ops_per_sec_{GROUPS}_groups_half_sequential_reads"),
+        "value": round(best, 1),
+        "unit": "ops/sec",
+        "vs_baseline": round(best / NORTH_STAR_OPS, 4),
+    }
+
+
+def main() -> None:
+    if SCENARIO == "election":
+        result = run_election()
+    elif SCENARIO == "map_read":
+        result = run_map_read()
+    elif SCENARIO in SUBMIT_BUILDERS:
+        result = run_throughput(SCENARIO)
+    else:
+        raise SystemExit(f"unknown scenario {SCENARIO!r}; pick one of "
+                         f"{['election', 'map_read', *SUBMIT_BUILDERS]}")
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -7,8 +7,6 @@ that repeatedly sets and reads a distributed value.
 import asyncio
 import sys
 
-sys.path.insert(0, ".")
-
 from copycat_tpu.atomic import DistributedAtomicValue
 from copycat_tpu.io.tcp import TcpTransport
 from copycat_tpu.io.transport import Address

@@ -7,8 +7,6 @@ replica that joins a membership group and prints join/leave events.
 import asyncio
 import sys
 
-sys.path.insert(0, ".")
-
 from copycat_tpu.coordination import DistributedMembershipGroup
 from copycat_tpu.io.tcp import TcpTransport
 from copycat_tpu.io.transport import Address

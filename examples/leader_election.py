@@ -13,8 +13,6 @@ election; when elected it prints so and verifies its epoch periodically.
 import asyncio
 import sys
 
-sys.path.insert(0, ".")
-
 from copycat_tpu.coordination import DistributedLeaderElection
 from copycat_tpu.io.tcp import TcpTransport
 from copycat_tpu.io.transport import Address

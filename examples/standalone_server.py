@@ -8,8 +8,6 @@ import asyncio
 import sys
 import tempfile
 
-sys.path.insert(0, ".")
-
 from copycat_tpu.io.tcp import TcpTransport
 from copycat_tpu.io.transport import Address
 from copycat_tpu.manager.atomix import AtomixServer
@@ -35,5 +33,9 @@ async def main() -> None:
         await asyncio.sleep(10)
 
 
-if __name__ == "__main__":
+def run() -> None:
     asyncio.run(main())
+
+
+if __name__ == "__main__":
+    run()
