@@ -401,3 +401,84 @@ def test_all_pools_converge_under_partitions():
             first = arr[g, 0]
             for p in range(1, P):
                 assert (arr[g, p] == first).all(), (name, g, arr[g])
+
+
+# ---- outbox-ring overflow: event loss + authoritative fallback -------------
+#
+# VERDICT weak-#4 / next-#8: the outbox is a drop-oldest ring (apply.py,
+# "drop oldest" at the event push); an evicted grant/elect event is gone for
+# good, and the facades' documented recovery is the authoritative replicated
+# register (OP_LOCK_HOLDER / OP_ELECT_LEADER).  These tests force the loss
+# deterministically — event_slots=1 and two event-producing commits applied
+# in the same round, so the second push evicts the first — then assert the
+# facade recovers through the fallback, not the event.
+
+def _overflow_groups():
+    from copycat_tpu.models import DeviceElection, DeviceLock
+    from copycat_tpu.ops.consensus import Config
+    from copycat_tpu.ops.apply import ResourceConfig
+    cfg = Config(resource=ResourceConfig(
+        map_slots=0, set_slots=0, queue_slots=0,
+        wait_slots=4, listener_slots=4, event_slots=1))
+    rg = RaftGroups(1, 3, log_slots=64, config=cfg)
+    rg.wait_for_leaders()
+    a, b = DeviceLock(rg, 0, 1), DeviceLock(rg, 0, 2)
+    e1, e2 = DeviceElection(rg, 0, 11), DeviceElection(rg, 0, 12)
+    a.lock()
+    assert e1.listen() is not None      # elected immediately, no event
+    assert e2.listen() is None          # queued successor
+    # B queues behind A; the grant will arrive by event (or not, below)
+    acquire = rg.submit(0, ap.OP_LOCK_ACQUIRE, 2, -1)
+    rg.run_until([acquire])
+    assert rg.results.pop(acquire) not in (0, 1)  # queued, not granted/full
+    return rg, a, b, e1, e2
+
+
+def _same_round_commits(rg, ops_):
+    tags = [rg.submit(0, *op) for op in ops_]
+    rg.run_until(tags)
+    return [rg.results.pop(t) for t in tags]
+
+
+def test_lost_lock_grant_recovered_via_holder_register():
+    rg, a, b, e1, e2 = _overflow_groups()
+    # release(A) grants B (event #1); resign(e1) elects e2 (event #2).
+    # Both commit in one submit batch -> both apply in one round -> the
+    # 1-slot ring drops the grant, keeps the elect.
+    res = _same_round_commits(
+        rg, [(ap.OP_LOCK_RELEASE, 1), (ap.OP_ELECT_RESIGN, 11)])
+    assert res == [1, 1]
+    rg.run(8)  # drain whatever survived in the ring
+    evs = rg.events.get(0, [])
+    assert any(c == ap.EV_ELECT and t == 12 for _, c, t, _a in evs)
+    assert not any(c == ap.EV_LOCK_GRANT for _, c, t, _a in evs), evs
+    # the facade must still converge, via the authoritative holder register
+    assert b._await_grant(None) is True
+    assert b._call(ap.OP_LOCK_HOLDER) == 2
+    # and the election facade sees its (surviving) event the normal way
+    assert e2.poll_elected() is not None
+    assert e2.is_leader()
+
+
+def test_lost_elect_event_recovered_via_leader_register():
+    rg, a, b, e1, e2 = _overflow_groups()
+    # reversed order: the elect event is pushed first and evicted by the
+    # lock grant
+    res = _same_round_commits(
+        rg, [(ap.OP_ELECT_RESIGN, 11), (ap.OP_LOCK_RELEASE, 1)])
+    assert res == [1, 1]
+    rg.run(8)
+    evs = rg.events.get(0, [])
+    assert any(c == ap.EV_LOCK_GRANT and t == 2 for _, c, t, _a in evs)
+    assert not any(c == ap.EV_ELECT for _, c, t, _a in evs), evs
+    # poll_elected never sees the event; the every-20-polls fallback must
+    # consult OP_ELECT_LEADER and recover the epoch + fencing token
+    epoch = None
+    for _ in range(25):
+        epoch = e2.poll_elected()
+        if epoch is not None:
+            break
+    assert epoch is not None
+    assert e2.is_leader(epoch)
+    # the lock side converges on its surviving event
+    assert b._await_grant(None) is True
