@@ -14,6 +14,7 @@ bitmap per group (``copycat_tpu.ops.logring``); this CPU log is the oracle.
 from __future__ import annotations
 
 import enum
+import mmap
 import os
 from typing import Any, Iterator
 
@@ -23,8 +24,8 @@ from ..io.serializer import Serializer, serialize_with
 
 class StorageLevel(enum.Enum):
     MEMORY = "memory"
-    MAPPED = "mapped"  # currently same path as DISK (buffered files)
-    DISK = "disk"
+    MAPPED = "mapped"  # mmap-backed segments (page-cache writes, no syscalls)
+    DISK = "disk"      # buffered files, flushed per append
 
 
 class Storage:
@@ -44,6 +45,47 @@ class Storage:
 
     def build_log(self, name: str = "log") -> "Log":
         return Log(self, name)
+
+
+class _MappedSegment:
+    """One mmap-backed log segment: ``[u64 watermark][frames...]``.
+
+    The MAPPED level of the reference Storage contract: appends are memory
+    copies into the OS page cache through the mapping — no write/flush
+    syscall per entry (DISK pays both).  Durability is page-cache-deep until
+    ``close()`` (which msyncs); recovery trusts the persisted watermark, so a
+    torn final frame after a crash is simply not observed.
+    """
+
+    HEADER = 8
+
+    def __init__(self, path: str, capacity: int) -> None:
+        self._f = open(path, "w+b")
+        self._f.truncate(self.HEADER + capacity)
+        self._mm = mmap.mmap(self._f.fileno(), 0)
+        self._used = 0
+
+    def append(self, frame: bytes) -> bool:
+        """Copy a frame in; False when it doesn't fit (caller rolls over)."""
+        start = self.HEADER + self._used
+        if start + len(frame) > len(self._mm):
+            return False
+        self._mm[start:start + len(frame)] = frame
+        self._used += len(frame)
+        self._mm[:self.HEADER] = self._used.to_bytes(self.HEADER, "little")
+        return True
+
+    def close(self) -> None:
+        self._mm.flush()
+        self._mm.close()
+        self._f.close()
+
+    @staticmethod
+    def read_payload(path: str) -> bytes:
+        """Valid frame bytes of a closed/crashed segment (watermark-bounded)."""
+        with open(path, "rb") as f:
+            used = int.from_bytes(f.read(_MappedSegment.HEADER), "little")
+            return f.read(used)
 
 
 class Entry(object):
@@ -132,7 +174,8 @@ class Log:
         # checks and vote up-to-date comparisons after compaction.
         self._term_starts: list[tuple[int, int]] = []
         self._serializer = Serializer()
-        self._segment_file = None
+        self._segment_file = None          # DISK: buffered append file
+        self._mapped: _MappedSegment | None = None  # MAPPED: mmap segment
         self._segment_count = 0
         self._segment_index = 0
         if storage.level in (StorageLevel.DISK, StorageLevel.MAPPED):
@@ -272,18 +315,40 @@ class Log:
             return self._storage.directory
         return None
 
+    #: MAPPED segment capacity (frame bytes; oversize frames get their own
+    #: segment).  Small segments keep the reference's roll-over semantics
+    #: (``withMaxEntriesPerSegment``) observable in tests.
+    MAPPED_SEGMENT_BYTES = 1 << 16
+
     def _segment_path(self, index: int) -> str:
-        return os.path.join(self._segment_dir, f"{self._name}-{index}.seg")
+        ext = "mseg" if self._storage.level is StorageLevel.MAPPED else "seg"
+        return os.path.join(self._segment_dir, f"{self._name}-{index}.{ext}")
 
     def _persist(self, entry: Entry) -> None:
+        data = self._serializer.write(entry)
+        frame = BufferOutput().write_bytes(data).to_bytes()
+        if self._storage.level is StorageLevel.MAPPED:
+            roll = (self._mapped is None
+                    or self._segment_count >= self._storage.max_entries_per_segment)
+            if not roll and not self._mapped.append(frame):
+                roll = True  # full: close and start a segment that fits
+            if roll:
+                if self._mapped is not None:
+                    self._mapped.close()
+                self._segment_index = entry.index
+                self._mapped = _MappedSegment(
+                    self._segment_path(entry.index),
+                    max(self.MAPPED_SEGMENT_BYTES, len(frame)))
+                self._segment_count = 0
+                assert self._mapped.append(frame)
+            self._segment_count += 1
+            return
         if self._segment_file is None or self._segment_count >= self._storage.max_entries_per_segment:
             if self._segment_file is not None:
                 self._segment_file.close()
             self._segment_index = entry.index
             self._segment_file = open(self._segment_path(entry.index), "ab")
             self._segment_count = 0
-        data = self._serializer.write(entry)
-        frame = BufferOutput().write_bytes(data).to_bytes()
         self._segment_file.write(frame)
         self._segment_file.flush()
         self._segment_count += 1
@@ -291,11 +356,9 @@ class Log:
     def _persist_truncate(self, from_index: int) -> None:
         # Truncation is rare (follower conflict resolution): rewrite all
         # segments from the surviving in-memory entries.
-        if self._segment_file is not None:
-            self._segment_file.close()
-            self._segment_file = None
+        self.close()
         for fname in os.listdir(self._segment_dir):
-            if fname.startswith(f"{self._name}-") and fname.endswith(".seg"):
+            if fname.startswith(f"{self._name}-") and fname.endswith((".seg", ".mseg")):
                 os.remove(os.path.join(self._segment_dir, fname))
         self._segment_count = 0
         for entry in self._entries:
@@ -306,11 +369,18 @@ class Log:
         directory = self._storage.directory
         segments = []
         for fname in os.listdir(directory):
-            if fname.startswith(f"{self._name}-") and fname.endswith(".seg"):
-                segments.append((int(fname[len(self._name) + 1 : -4]), fname))
-        for _, fname in sorted(segments):
-            with open(os.path.join(directory, fname), "rb") as f:
-                data = f.read()
+            if not fname.startswith(f"{self._name}-"):
+                continue
+            stem, dot, ext = fname.rpartition(".")
+            if ext in ("seg", "mseg"):
+                segments.append((int(stem[len(self._name) + 1:]), fname, ext))
+        for _, fname, ext in sorted(segments):
+            path = os.path.join(directory, fname)
+            if ext == "mseg":
+                data = _MappedSegment.read_payload(path)
+            else:
+                with open(path, "rb") as f:
+                    data = f.read()
             buf = BufferInput(data)
             while buf.remaining > 0:
                 entry = self._serializer.read(buf.read_bytes())
@@ -330,3 +400,6 @@ class Log:
         if self._segment_file is not None:
             self._segment_file.close()
             self._segment_file = None
+        if self._mapped is not None:
+            self._mapped.close()
+            self._mapped = None

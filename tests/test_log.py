@@ -1,0 +1,105 @@
+"""Log storage-level tests (server/log.py).
+
+The reference Storage contract exposes three levels (SURVEY.md §2.3 storage
+row); MAPPED is a distinct path — mmap-backed segments whose recovery trusts
+a persisted watermark — not an alias of DISK's buffered+flushed files.
+"""
+
+import os
+
+from copycat_tpu.server.log import (
+    CommandEntry,
+    Log,
+    NoOpEntry,
+    Storage,
+    StorageLevel,
+)
+
+
+def _fill(log: Log, n: int, term: int = 1) -> None:
+    for i in range(n):
+        log.append(CommandEntry(term=term, timestamp=float(i),
+                                session_id=7, seq=i, operation=f"op-{i}"))
+
+
+def _segments(directory: str, ext: str) -> list[str]:
+    return sorted(f for f in os.listdir(directory) if f.endswith("." + ext))
+
+
+def test_disk_recover_roundtrip(tmp_path):
+    storage = Storage(StorageLevel.DISK, str(tmp_path), max_entries_per_segment=4)
+    log = storage.build_log()
+    _fill(log, 10)
+    log.close()
+    assert len(_segments(str(tmp_path), "seg")) >= 3
+    assert not _segments(str(tmp_path), "mseg")
+
+    recovered = storage.build_log()
+    assert recovered.last_index == 10
+    assert recovered.get(3).operation == "op-2"
+
+
+def test_mapped_recover_roundtrip(tmp_path):
+    storage = Storage(StorageLevel.MAPPED, str(tmp_path), max_entries_per_segment=4)
+    log = storage.build_log()
+    _fill(log, 10)
+    log.append(NoOpEntry(term=2, timestamp=10.0))
+    log.close()
+    # distinct on-disk format, rolled by entry count
+    assert len(_segments(str(tmp_path), "mseg")) >= 3
+    assert not _segments(str(tmp_path), "seg")
+
+    recovered = storage.build_log()
+    assert recovered.last_index == 11
+    assert recovered.get(5).operation == "op-4"
+    assert recovered.term_at(11) == 2
+    assert recovered.term_at(4) == 1
+
+
+def test_mapped_truncate_then_reopen(tmp_path):
+    storage = Storage(StorageLevel.MAPPED, str(tmp_path), max_entries_per_segment=4)
+    log = storage.build_log()
+    _fill(log, 9)
+    log.truncate(5)  # follower conflict resolution: drop [5..9]
+    log.append(CommandEntry(term=3, timestamp=9.0, session_id=7, seq=99,
+                            operation="new-5"))
+    log.close()
+
+    recovered = storage.build_log()
+    assert recovered.last_index == 5
+    assert recovered.get(5).operation == "new-5"
+    assert recovered.get(5).term == 3
+    assert recovered.get(4).operation == "op-3"
+
+
+def test_mapped_watermark_bounds_torn_tail(tmp_path):
+    """Garbage past the watermark (a torn post-crash frame) is not observed."""
+    storage = Storage(StorageLevel.MAPPED, str(tmp_path), max_entries_per_segment=64)
+    log = storage.build_log()
+    _fill(log, 5)
+    log.close()
+    (path,) = (os.path.join(str(tmp_path), f)
+               for f in _segments(str(tmp_path), "mseg"))
+    with open(path, "r+b") as f:
+        used = int.from_bytes(f.read(8), "little")
+        f.seek(8 + used)
+        f.write(b"\xde\xad\xbe\xef" * 8)  # torn bytes inside the capacity
+
+    recovered = storage.build_log()
+    assert recovered.last_index == 5
+    assert recovered.get(5).operation == "op-4"
+
+
+def test_mapped_oversize_frame_gets_own_segment(tmp_path):
+    storage = Storage(StorageLevel.MAPPED, str(tmp_path), max_entries_per_segment=64)
+    log = storage.build_log()
+    big = "x" * (Log.MAPPED_SEGMENT_BYTES + 1024)
+    log.append(CommandEntry(term=1, timestamp=0.0, session_id=1, seq=0,
+                            operation="small"))
+    log.append(CommandEntry(term=1, timestamp=1.0, session_id=1, seq=1,
+                            operation=big))
+    log.close()
+    assert len(_segments(str(tmp_path), "mseg")) == 2
+
+    recovered = storage.build_log()
+    assert recovered.get(2).operation == big
