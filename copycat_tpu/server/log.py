@@ -16,6 +16,7 @@ from __future__ import annotations
 import enum
 import mmap
 import os
+import zlib
 from typing import Any, Iterator
 
 from ..io.buffer import BufferInput, BufferOutput
@@ -53,11 +54,15 @@ class _MappedSegment:
     The MAPPED level of the reference Storage contract: appends are memory
     copies into the OS page cache through the mapping — no write/flush
     syscall per entry (DISK pays both).  Durability is page-cache-deep until
-    ``close()`` (which msyncs); recovery trusts the persisted watermark, so a
-    torn final frame after a crash is simply not observed.
+    ``close()`` (which msyncs).  Kernel writeback order between the
+    watermark page and frame pages is unspecified, so the watermark alone
+    cannot bound a torn tail; each frame therefore carries
+    ``[u32 len][u32 crc32]`` and recovery stops at the first frame whose
+    checksum fails — everything before it is intact by construction.
     """
 
     HEADER = 8
+    FRAME_HEADER = 8  # u32 payload length + u32 crc32
 
     def __init__(self, path: str, capacity: int) -> None:
         self._f = open(path, "w+b")
@@ -65,13 +70,16 @@ class _MappedSegment:
         self._mm = mmap.mmap(self._f.fileno(), 0)
         self._used = 0
 
-    def append(self, frame: bytes) -> bool:
+    def append(self, payload: bytes) -> bool:
         """Copy a frame in; False when it doesn't fit (caller rolls over)."""
         start = self.HEADER + self._used
-        if start + len(frame) > len(self._mm):
+        total = self.FRAME_HEADER + len(payload)
+        if start + total > len(self._mm):
             return False
-        self._mm[start:start + len(frame)] = frame
-        self._used += len(frame)
+        header = (len(payload).to_bytes(4, "little")
+                  + zlib.crc32(payload).to_bytes(4, "little"))
+        self._mm[start:start + total] = header + payload
+        self._used += total
         self._mm[:self.HEADER] = self._used.to_bytes(self.HEADER, "little")
         return True
 
@@ -81,11 +89,23 @@ class _MappedSegment:
         self._f.close()
 
     @staticmethod
-    def read_payload(path: str) -> bytes:
-        """Valid frame bytes of a closed/crashed segment (watermark-bounded)."""
+    def read_payloads(path: str) -> list[bytes]:
+        """CRC-valid frame payloads of a closed/crashed segment, stopping
+        at the first torn frame (watermark- and checksum-bounded)."""
         with open(path, "rb") as f:
             used = int.from_bytes(f.read(_MappedSegment.HEADER), "little")
-            return f.read(used)
+            data = f.read(used)
+        payloads = []
+        pos = 0
+        while pos + _MappedSegment.FRAME_HEADER <= len(data):
+            length = int.from_bytes(data[pos:pos + 4], "little")
+            crc = int.from_bytes(data[pos + 4:pos + 8], "little")
+            payload = data[pos + 8:pos + 8 + length]
+            if len(payload) < length or zlib.crc32(payload) != crc:
+                break  # torn tail: everything before it is intact
+            payloads.append(payload)
+            pos += _MappedSegment.FRAME_HEADER + length
+        return payloads
 
 
 class Entry(object):
@@ -177,7 +197,6 @@ class Log:
         self._segment_file = None          # DISK: buffered append file
         self._mapped: _MappedSegment | None = None  # MAPPED: mmap segment
         self._segment_count = 0
-        self._segment_index = 0
         if storage.level in (StorageLevel.DISK, StorageLevel.MAPPED):
             assert storage.directory, "DISK/MAPPED storage requires a directory"
             os.makedirs(storage.directory, exist_ok=True)
@@ -326,27 +345,27 @@ class Log:
 
     def _persist(self, entry: Entry) -> None:
         data = self._serializer.write(entry)
-        frame = BufferOutput().write_bytes(data).to_bytes()
         if self._storage.level is StorageLevel.MAPPED:
             roll = (self._mapped is None
                     or self._segment_count >= self._storage.max_entries_per_segment)
-            if not roll and not self._mapped.append(frame):
+            if not roll and not self._mapped.append(data):
                 roll = True  # full: close and start a segment that fits
             if roll:
                 if self._mapped is not None:
                     self._mapped.close()
-                self._segment_index = entry.index
                 self._mapped = _MappedSegment(
                     self._segment_path(entry.index),
-                    max(self.MAPPED_SEGMENT_BYTES, len(frame)))
+                    max(self.MAPPED_SEGMENT_BYTES,
+                        _MappedSegment.FRAME_HEADER + len(data)))
                 self._segment_count = 0
-                assert self._mapped.append(frame)
+                if not self._mapped.append(data):
+                    raise AssertionError("fresh mapped segment rejected frame")
             self._segment_count += 1
             return
+        frame = BufferOutput().write_bytes(data).to_bytes()
         if self._segment_file is None or self._segment_count >= self._storage.max_entries_per_segment:
             if self._segment_file is not None:
                 self._segment_file.close()
-            self._segment_index = entry.index
             self._segment_file = open(self._segment_path(entry.index), "ab")
             self._segment_count = 0
         self._segment_file.write(frame)
@@ -377,13 +396,15 @@ class Log:
         for _, fname, ext in sorted(segments):
             path = os.path.join(directory, fname)
             if ext == "mseg":
-                data = _MappedSegment.read_payload(path)
+                payloads = _MappedSegment.read_payloads(path)
             else:
                 with open(path, "rb") as f:
-                    data = f.read()
-            buf = BufferInput(data)
-            while buf.remaining > 0:
-                entry = self._serializer.read(buf.read_bytes())
+                    buf = BufferInput(f.read())
+                payloads = []
+                while buf.remaining > 0:
+                    payloads.append(buf.read_bytes())
+            for payload in payloads:
+                entry = self._serializer.read(payload)
                 # Replayed entries keep their persisted indices.  Gap-filled
                 # (compacted-elsewhere) slots were never persisted, so recovery
                 # re-creates the gaps as None slots.

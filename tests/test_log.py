@@ -103,3 +103,25 @@ def test_mapped_oversize_frame_gets_own_segment(tmp_path):
 
     recovered = storage.build_log()
     assert recovered.get(2).operation == big
+
+
+def test_mapped_crc_bounds_reordered_writeback(tmp_path):
+    """Kernel writeback may flush the watermark page before the tail frame's
+    pages; recovery must CRC-reject the unwritten (zeroed) tail frame and
+    keep everything before it."""
+    storage = Storage(StorageLevel.MAPPED, str(tmp_path), max_entries_per_segment=64)
+    log = storage.build_log()
+    _fill(log, 6)
+    log.close()
+    (path,) = (os.path.join(str(tmp_path), f)
+               for f in _segments(str(tmp_path), "mseg"))
+    # Simulate the torn state: watermark says 6 frames are valid, but the
+    # last frame's payload never hit the disk (zero it, keep the watermark).
+    with open(path, "r+b") as f:
+        used = int.from_bytes(f.read(8), "little")
+        f.seek(8 + used - (used // 6) + 8)  # past the last frame's header
+        f.write(b"\x00" * (used // 6 - 8))
+
+    recovered = storage.build_log()
+    assert recovered.last_index == 5          # torn frame 6 dropped
+    assert recovered.get(5).operation == "op-4"
