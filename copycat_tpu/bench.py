@@ -59,7 +59,7 @@ RESOURCE_CONFIGS = {
                           listener_slots=0, event_slots=0),
     "lock": ResourceConfig(map_slots=0, set_slots=0, queue_slots=0,
                            listener_slots=0),
-    "mixed": ResourceConfig(set_slots=0, queue_slots=0, listener_slots=0),
+    "mixed": ResourceConfig(),  # every pool live: full-system config #5
 }
 
 SCENARIO = os.environ.get("COPYCAT_BENCH_SCENARIO", "counter")
@@ -71,7 +71,14 @@ ROUNDS = int(os.environ.get("COPYCAT_BENCH_ROUNDS", "200"))
 REPEATS = int(os.environ.get("COPYCAT_BENCH_REPEATS", "3"))
 SUBMIT_SLOTS = int(os.environ.get("COPYCAT_BENCH_SUBMIT_SLOTS", "16"))
 NORTH_STAR_OPS = 1_000_000.0
-USE_PALLAS = os.environ.get("COPYCAT_BENCH_PALLAS", "0") == "1"
+# Default the Pallas quorum-tally kernel ON for TPU: measured at parity
+# with the jnp path after the one-hot rewrite (PERF.md §Pallas A/B — the
+# step is dispatch-bound, not tally-bound), and running it keeps the
+# production kernel exercised. CPU keeps the jnp path (interpret mode is
+# test-only).
+USE_PALLAS = os.environ.get(
+    "COPYCAT_BENCH_PALLAS",
+    "1" if jax.default_backend() == "tpu" else "0") == "1"
 # Set to a directory to capture an XLA profiler trace of the first timed
 # repetition (open in TensorBoard/XProf, or summarize with
 # copycat_tpu.utils.profiling.summarize_trace).
@@ -114,10 +121,12 @@ def counter_submits(G: int) -> Submits:
 
 
 def map_submits(G: int) -> Submits:
-    """put/put/get/get over rotating keys (hashed-keyspace kernel)."""
+    """put/get mix over 10 rotating keys per group (BASELINE config #3:
+    "10k keys × 1k groups" = 10 keys/group at G=1000, hashed-keyspace
+    kernel)."""
     ones = jnp.ones((G, SUBMIT_SLOTS), jnp.int32)
-    opc = [ap.OP_MAP_PUT, ap.OP_MAP_PUT, ap.OP_MAP_GET, ap.OP_MAP_GET]
-    keys = [1, 2, 1, 2]
+    opc = [ap.OP_MAP_PUT, ap.OP_MAP_GET] * 5
+    keys = [1, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 2, 3, 6, 8, 10]
     return Submits(opcode=tile_pattern(opc, G), a=tile_pattern(keys, G),
                    b=ones * 7, c=ones * 0, tag=ones,
                    valid=ones.astype(bool))
@@ -139,11 +148,19 @@ def lock_submits(G: int) -> Submits:
 
 
 def mixed_submits(G: int) -> Submits:
+    """Every resource kernel in one round (BASELINE config #5): counter,
+    map, set, queue, lock grant chain, election listen/resign — so the
+    nemesis run exercises all apply paths plus the event outbox."""
     ones = jnp.ones((G, SUBMIT_SLOTS), jnp.int32)
-    opc = [ap.OP_LONG_ADD, ap.OP_MAP_PUT,
-           ap.OP_LOCK_ACQUIRE, ap.OP_LOCK_RELEASE]
-    a = [1, 3, 9, 9]
-    b = [0, 5, -1, 0]
+    opc = [ap.OP_LONG_ADD, ap.OP_MAP_PUT, ap.OP_MAP_GET,
+           ap.OP_SET_ADD, ap.OP_SET_REMOVE,
+           ap.OP_Q_OFFER, ap.OP_Q_POLL,
+           ap.OP_LOCK_ACQUIRE, ap.OP_LOCK_RELEASE,
+           ap.OP_ELECT_LISTEN, ap.OP_ELECT_RESIGN,
+           ap.OP_LONG_ADD, ap.OP_MAP_PUT,
+           ap.OP_Q_OFFER, ap.OP_Q_POLL, ap.OP_MAP_GET]
+    a = [1, 3, 3, 5, 5, 6, 0, 9, 9, 4, 4, 1, 7, 6, 0, 7]
+    b = [0, 5, 0, 0, 0, 0, 0, -1, 0, 0, 0, 0, 8, 0, 0, 0]
     return Submits(opcode=tile_pattern(opc, G), a=tile_pattern(a, G),
                    b=tile_pattern(b, G),
                    c=ones * 0, tag=ones, valid=ones.astype(bool))
@@ -224,8 +241,14 @@ def run_throughput(scenario: str) -> dict:
                   else deliver)
             state, out = step(state, submits, dl, k, config=config)
             lat = jnp.clip(out.out_latency.reshape(-1), 0, max_lat - 1)
-            hist = jnp.zeros(max_lat, jnp.int32).at[lat].add(
-                out.out_valid.reshape(-1).astype(jnp.int32))
+            # one-hot select-reduce, NOT .at[].add(): XLA lowers the scatter
+            # to an element-at-a-time DMA loop that costs more than the whole
+            # consensus step (see PERF.md — same pathology as the engine's
+            # round-2 gather/scatter rewrite, rediscovered here by profile)
+            hist = jnp.sum(
+                (lat[:, None] == jnp.arange(max_lat, dtype=jnp.int32)[None, :])
+                & out.out_valid.reshape(-1)[:, None],
+                axis=0, dtype=jnp.int32)
             # exact-once committed-op count: global applied high-water delta
             # (out_valid reports are at-least-once across leader changes)
             applied_now = jnp.max(state.applied_index, axis=1)

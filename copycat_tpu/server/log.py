@@ -63,6 +63,10 @@ class _MappedSegment:
 
     HEADER = 8
     FRAME_HEADER = 8  # u32 payload length + u32 crc32
+    #: Nonzero CRC seed: crc32(b"") == 0, so with a zero seed an all-zero
+    #: torn frame (header page never written back) would VALIDATE as an
+    #: empty frame. Seeding makes all-zero bytes fail the check.
+    CRC_SEED = 0xA5C3
 
     def __init__(self, path: str, capacity: int) -> None:
         self._f = open(path, "w+b")
@@ -77,7 +81,7 @@ class _MappedSegment:
         if start + total > len(self._mm):
             return False
         header = (len(payload).to_bytes(4, "little")
-                  + zlib.crc32(payload).to_bytes(4, "little"))
+                  + zlib.crc32(payload, self.CRC_SEED).to_bytes(4, "little"))
         self._mm[start:start + total] = header + payload
         self._used += total
         self._mm[:self.HEADER] = self._used.to_bytes(self.HEADER, "little")
@@ -101,7 +105,8 @@ class _MappedSegment:
             length = int.from_bytes(data[pos:pos + 4], "little")
             crc = int.from_bytes(data[pos + 4:pos + 8], "little")
             payload = data[pos + 8:pos + 8 + length]
-            if len(payload) < length or zlib.crc32(payload) != crc:
+            if (length == 0 or len(payload) < length
+                    or zlib.crc32(payload, _MappedSegment.CRC_SEED) != crc):
                 break  # torn tail: everything before it is intact
             payloads.append(payload)
             pos += _MappedSegment.FRAME_HEADER + length

@@ -116,12 +116,29 @@ def test_mapped_crc_bounds_reordered_writeback(tmp_path):
     (path,) = (os.path.join(str(tmp_path), f)
                for f in _segments(str(tmp_path), "mseg"))
     # Simulate the torn state: watermark says 6 frames are valid, but the
-    # last frame's payload never hit the disk (zero it, keep the watermark).
+    # last frame — HEADER PAGE INCLUDED — never hit the disk. The all-zero
+    # header must not validate (crc32(b"")==0 would, without the seed).
     with open(path, "r+b") as f:
         used = int.from_bytes(f.read(8), "little")
-        f.seek(8 + used - (used // 6) + 8)  # past the last frame's header
-        f.write(b"\x00" * (used // 6 - 8))
+        f.seek(8 + used - (used // 6))       # start of the last frame
+        f.write(b"\x00" * (used // 6))
 
     recovered = storage.build_log()
     assert recovered.last_index == 5          # torn frame 6 dropped
     assert recovered.get(5).operation == "op-4"
+
+    # Payload-only tear (header survived, payload pages did not).
+    storage2 = Storage(StorageLevel.MAPPED, str(tmp_path) + "2",
+                       max_entries_per_segment=64)
+    log2 = storage2.build_log()
+    _fill(log2, 6)
+    log2.close()
+    (path2,) = (os.path.join(str(tmp_path) + "2", f)
+                for f in _segments(str(tmp_path) + "2", "mseg"))
+    with open(path2, "r+b") as f:
+        used = int.from_bytes(f.read(8), "little")
+        f.seek(8 + used - (used // 6) + 8)   # past the last frame's header
+        f.write(b"\x00" * (used // 6 - 8))
+    recovered2 = storage2.build_log()
+    assert recovered2.last_index == 5
+    assert recovered2.get(5).operation == "op-4"
