@@ -82,6 +82,14 @@ USE_PALLAS = os.environ.get(
 # Set to a directory to capture an XLA profiler trace of the first timed
 # repetition (open in TensorBoard/XProf, or summarize with
 # copycat_tpu.utils.profiling.summarize_trace).
+# Fully unroll the apply loop on TPU: lax.scan blocks cross-iteration
+# fusion, so the scanned form streams every pool's state once per apply;
+# unrolled, XLA fuses consecutive applies into far fewer HBM passes
+# (mixed 100k x 5: 122 -> 52 ms/round, PERF.md). Costs ~30s extra compile.
+APPLY_UNROLL = int(os.environ.get(
+    "COPYCAT_BENCH_UNROLL",
+    str(max(4, SUBMIT_SLOTS)) if jax.default_backend() == "tpu" else "1"))
+
 PROFILE_DIR = os.environ.get("COPYCAT_BENCH_PROFILE", "")
 
 
@@ -210,6 +218,7 @@ def run_throughput(scenario: str) -> dict:
     config = Config(use_pallas=USE_PALLAS,
                     append_window=max(4, SUBMIT_SLOTS),
                     applies_per_round=max(4, SUBMIT_SLOTS),
+                    apply_unroll=APPLY_UNROLL,
                     resource=RESOURCE_CONFIGS.get(scenario, ResourceConfig()))
     key = jax.random.PRNGKey(0)
     key, init_key = jax.random.split(key)
@@ -367,6 +376,7 @@ def run_map_read() -> dict:
     reference's sub-ATOMIC query routing at batch scale."""
     config = Config(use_pallas=USE_PALLAS, append_window=max(4, SUBMIT_SLOTS),
                     applies_per_round=max(4, SUBMIT_SLOTS),
+                    apply_unroll=APPLY_UNROLL,
                     resource=RESOURCE_CONFIGS["map"])
     key = jax.random.PRNGKey(0)
     key, init_key = jax.random.split(key)

@@ -261,9 +261,17 @@ def _scatter3(arr: jnp.ndarray, slot: jnp.ndarray, mask: jnp.ndarray,
 
 
 def _first_true(mask: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """(index of first True along last axis, any True) for mask[G,P,N]."""
-    idx = jnp.argmax(mask, axis=-1).astype(jnp.int32)
-    return idx, jnp.any(mask, axis=-1)
+    """(index of first True along last axis, any True) for mask[G,P,N].
+
+    One max-reduce instead of argmax + any (two reduces): score slot i as
+    N-i where mask holds, 0 otherwise — the max is N-first_index, and 0
+    means no hit. Profiled in the apply scan: argmax+reduce_or were ~25%
+    of the mixed round (PERF.md)."""
+    N = mask.shape[-1]
+    score = jnp.where(mask, N - jnp.arange(N, dtype=jnp.int32), 0)
+    best = jnp.max(score, axis=-1)
+    found = best > 0
+    return jnp.where(found, N - best, 0).astype(jnp.int32), found
 
 
 def _ring_pos(head: jnp.ndarray, n: int) -> jnp.ndarray:
@@ -279,12 +287,20 @@ def _ring_compact(mask: jnp.ndarray, head, size, pos, live_arr, live_win,
     preserved (argsort key = pos for live, pos+N for dead). Lanes where
     ``mask`` is False keep every field untouched."""
     N = arrays[0].shape[-1]
-    order = jnp.argsort(jnp.where(live_win, pos, N + pos), axis=-1)
+    # Stable live-first order WITHOUT argsort: ring positions are a
+    # permutation of 0..N-1, so the keys (pos for live, N+pos for dead) are
+    # pairwise distinct and each slot's target rank is just how many keys
+    # are smaller — O(N²) vector compares beat the sort network (PERF.md).
+    key = jnp.where(live_win, pos, N + pos)
+    rank = jnp.sum((key[..., None, :] < key[..., :, None]).astype(jnp.int32),
+                   axis=-1)                                   # [G,P,N]
     count = jnp.sum(live_win, axis=-1).astype(jnp.int32)
     m3 = mask[..., None]
     # permutation as a one-hot [G,P,N,N] select-reduce (N is small); the
-    # take_along_axis equivalent lowers to an element-wise DMA loop on TPU
-    perm = order[..., None] == jnp.arange(N, dtype=jnp.int32)
+    # take_along_axis equivalent lowers to an element-wise DMA loop on TPU.
+    # perm[i, j] == True iff the slot moving to position i is j, i.e.
+    # rank[j] == i.
+    perm = rank[..., None, :] == jnp.arange(N, dtype=jnp.int32)[:, None]
     pick = lambda arr: jnp.where(perm, arr[..., None, :], 0).sum(-1).astype(
         arr.dtype)
     out = [jnp.where(m3, pick(arr), arr) for arr in arrays]

@@ -124,6 +124,9 @@ class Config(NamedTuple):
 
     append_window: int = 4    # entries per AppendEntries per round
     applies_per_round: int = 4
+    apply_unroll: int = 1     # lax.scan unroll of the apply loop: >1 lets
+    #                           XLA fuse consecutive applies into fewer
+    #                           full-pool HBM passes (see PERF.md)
     timer_min: int = 4        # election timeout in rounds (randomized range)
     timer_max: int = 9
     events_per_round: int = 4  # outbox events drained per step
@@ -589,7 +592,8 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
             resources, op_i, a_i, b_i, c_i, idx, time_i, do)
         return resources, result
 
-    resources, res_all = jax.lax.scan(_apply_one, state.resources, xs)
+    resources, res_all = jax.lax.scan(_apply_one, state.resources, xs,
+                                  unroll=config.apply_unroll)
     applied = post_applied
 
     # Reporting-lane views, one fused pass each over [G,P,A].
