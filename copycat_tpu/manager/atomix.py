@@ -95,6 +95,17 @@ class _Builder:
         self._kwargs["session_timeout"] = timeout
         return self
 
+    def with_executor(self, executor: str,
+                      engine_config: Any | None = None) -> "_Builder":
+        """Select the resource executor: ``"cpu"`` (default) or ``"tpu"``
+        — the vectorized device engine behind the same resource API
+        (SURVEY.md §7.1; mirror of ``withStateMachine``,
+        ``AtomixReplica.java:374``). Must be uniform across the cluster."""
+        self._kwargs["executor"] = executor
+        if engine_config is not None:
+            self._kwargs["engine_config"] = engine_config
+        return self
+
     def build(self) -> Any:
         kwargs = dict(self._kwargs)
         if self._cls is AtomixClient:
@@ -102,6 +113,8 @@ class _Builder:
             kwargs.pop("storage", None)
             kwargs.pop("election_timeout", None)
             kwargs.pop("heartbeat_interval", None)
+            kwargs.pop("executor", None)
+            kwargs.pop("engine_config", None)
         return self._cls(**kwargs)
 
 
@@ -130,9 +143,13 @@ class AtomixReplica(Atomix):
         election_timeout: float = 0.5,
         heartbeat_interval: float = 0.1,
         session_timeout: float = 5.0,
+        executor: str = "cpu",
+        engine_config: Any | None = None,
     ) -> None:
         self.server = RaftServer(
-            address, members, transport, ResourceManager(), storage=storage,
+            address, members, transport,
+            ResourceManager(executor=executor, engine_config=engine_config),
+            storage=storage,
             election_timeout=election_timeout, heartbeat_interval=heartbeat_interval,
             session_timeout=session_timeout)
         client = RaftClient(
@@ -147,6 +164,7 @@ class AtomixReplica(Atomix):
 
     async def _do_open(self) -> None:
         # Server first, then the client session (reference AtomixReplica.open).
+        self.server.state_machine.prewarm()
         await self.server.open()
         await self.client.open()
 
@@ -168,10 +186,14 @@ class AtomixServer(Managed):
         election_timeout: float = 0.5,
         heartbeat_interval: float = 0.1,
         session_timeout: float = 5.0,
+        executor: str = "cpu",
+        engine_config: Any | None = None,
     ) -> None:
         super().__init__()
         self.server = RaftServer(
-            address, members, transport, ResourceManager(), storage=storage,
+            address, members, transport,
+            ResourceManager(executor=executor, engine_config=engine_config),
+            storage=storage,
             election_timeout=election_timeout, heartbeat_interval=heartbeat_interval,
             session_timeout=session_timeout)
         self.address = address
@@ -181,6 +203,7 @@ class AtomixServer(Managed):
         return _Builder(AtomixServer, address, members)
 
     async def _do_open(self) -> None:
+        self.server.state_machine.prewarm()
         await self.server.open()
 
     async def _do_close(self) -> None:

@@ -1,0 +1,1116 @@
+"""The TPU executor behind the Atomix SPI.
+
+SURVEY.md §7.1: "the TPU executor selectable at replica build time (mirror
+of ``withStateMachine(new ResourceManager())`` at ``AtomixReplica.java:374``)".
+A replica/server built with ``executor="tpu"`` routes ``get``/``create`` of
+the fixed-shape resource types to the batched device engine — one device
+Raft group per resource instance, catalog unchanged in the
+:class:`~copycat_tpu.manager.state.ResourceManager` — while every other
+type (and device-pool overflow / non-int32 payloads) transparently stays on
+the CPU state machines. Same public resource API either way.
+
+Architecture (two replication planes, one state machine discipline):
+
+- The CPU Raft log linearizes client ops ACROSS SERVER PROCESSES and owns
+  sessions, durability and compaction — exactly as for CPU resources.
+- Each server applies committed ops to its own in-process
+  :class:`DeviceEngine` (a ``RaftGroups`` batch — the flagship vectorized
+  consensus+apply program). Replica convergence across servers follows
+  from determinism: the engine's visible resource state is a pure function
+  of the committed device-op sequence, which is identical on every server
+  because it is derived from the shared CPU log in apply order.
+
+Determinism rules the device-backed machines must (and do) observe:
+
+1. Device ops never carry device-clock TTLs (``c``/deadline args are 0 or
+   sentinel): TTLs and lock timeouts run through the HOST'S replicated
+   log-time timers (``StateMachineExecutor.schedule`` — SURVEY.md §5.9),
+   so device resource state is independent of how many device rounds each
+   server happened to step.
+2. Queries never append device log entries (no escalation): the device
+   log stays ``[election NoOp] + committed commands`` on every server, so
+   log indexes — used as election fencing epochs — agree everywhere.
+3. Commits are retained host-side exactly like the CPU machines retain
+   them (``_Held`` discipline): the CPU log's compaction contract is
+   preserved; the device holds the *data plane*.
+
+Reference obligations: resource routing ``ResourceManager.java:56``,
+executor selection ``AtomixReplica.java:374``, state machine semantics
+``AtomicValueState.java:32``, ``MapState.java:32``, ``SetState.java:32``,
+``QueueState.java:30``, ``LockState.java:33``, ``LeaderElectionState.java:31``.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, NamedTuple
+
+from ..resource.state_machine import ResourceStateMachine
+from ..server.state_machine import Commit
+from ..atomic import commands as vc
+from ..collections import commands as cc
+from ..coordination import commands as oc
+
+INT32_MIN = -(2 ** 31)
+INT32_MAX = 2 ** 31 - 1
+
+
+def _devint(v: Any) -> bool:
+    """True if ``v`` can live in a device int32 lane.
+
+    ``bool`` is excluded (a device round-trip would turn ``True`` into
+    ``1`` — a visible type change vs the CPU path), as is the engine's
+    INT_MIN FAIL sentinel.
+    """
+    return (isinstance(v, int) and not isinstance(v, bool)
+            and INT32_MIN < v <= INT32_MAX)
+
+
+class DeviceEngineConfig(NamedTuple):
+    """Shape of the per-server device batch (uniform across the cluster —
+    the engine replicates deterministically only if every server runs the
+    same shapes, like ``withStateMachine`` must be uniform in the
+    reference)."""
+
+    capacity: int = 16        # device groups = max device-backed resources
+    num_peers: int = 3
+    log_slots: int = 64
+    submit_slots: int = 4
+    seed: int = 0             # shared PRNG seed — same election history
+
+
+class DeviceEngine:
+    """In-process device batch shared by all device-backed resources of one
+    server; allocates one group per resource instance.
+
+    Freed groups ARE reused: every device-backed machine resets its
+    device-resident state (clear/cancel/release commands) in ``delete()``
+    before releasing its group, so a recycled group starts clean. Reuse is
+    not just thrift — it makes the device-vs-CPU placement decision a
+    function of the LIVE device-resource count only, which is identical
+    between a full history and a compacted replay (compaction only drops
+    create/delete pairs, preserving the live set at every retained log
+    position); a monotonic allocator would instead diverge after restart.
+    When all groups are live, allocation returns ``None`` and the manager
+    falls back to the CPU state machine for that resource.
+    """
+
+    #: extra rounds stepped after each command so session events emitted by
+    #: the apply (lock grants, election promotions) are drained into the
+    #: host buffer before the handler returns — a fixed, deterministic
+    #: settle budget (events surface one round after the emitting apply).
+    SETTLE_ROUNDS = 2
+
+    def __init__(self, config: DeviceEngineConfig | None = None) -> None:
+        self.config = config or DeviceEngineConfig()
+        self._groups = None          # built lazily: first device resource
+        self._next_group = 0
+        self._free: list[int] = []   # released (reset) groups, lowest first
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _ensure(self):
+        if self._groups is None:
+            from ..models.raft_groups import RaftGroups
+            cfg = self.config
+            self._groups = RaftGroups(
+                cfg.capacity, cfg.num_peers, log_slots=cfg.log_slots,
+                submit_slots=cfg.submit_slots, seed=cfg.seed)
+            # Warm-up: deterministic election rounds (fixed seed). After
+            # this, full delivery keeps every leader stable, so queries are
+            # always servable without stepping.
+            self._groups.wait_for_leaders(max_rounds=200)
+        return self._groups
+
+    def allocate(self) -> int | None:
+        """Lowest free device group, or ``None`` when all are live."""
+        if self._free:
+            self._ensure()
+            import heapq
+            return heapq.heappop(self._free)
+        if self._next_group >= self.config.capacity:
+            return None
+        self._ensure()
+        group = self._next_group
+        self._next_group += 1
+        return group
+
+    def release(self, group: int) -> None:
+        """Return a group to the pool. The caller (the machine's
+        ``delete()``) must have reset the group's device state first."""
+        import heapq
+        heapq.heappush(self._free, group)
+
+    # -- op plane ----------------------------------------------------------
+
+    def command(self, group: int, opcode: int, a: int = 0, b: int = 0,
+                c: int = 0) -> int:
+        """Submit one committed device op and return its applied result."""
+        groups = self._ensure()
+        tag = groups.submit(group, opcode, a, b, c)
+        groups.run_until([tag])
+        for _ in range(self.SETTLE_ROUNDS):
+            groups.step_round()
+        return groups.results.pop(tag)
+
+    def query(self, group: int, opcode: int, a: int = 0, b: int = 0,
+              c: int = 0) -> int:
+        """Read-only op served from the leader lane's applied state.
+
+        Never appends to the device log (determinism rule #2) —
+        ``RaftGroups.serve_query`` is the non-escalating lane; after the
+        warm-up election the leader is stable and has applied everything
+        it committed, so it serves without stepping.
+        """
+        return self._ensure().serve_query(group, opcode, a, b, c)
+
+    def take_events(self, group: int, cursor: int) -> tuple[list, int]:
+        """Events for ``group`` with seq > cursor; returns (events, cursor)."""
+        if self._groups is None:
+            return [], cursor
+        out = []
+        for ev in self._groups.events.get(group, []):
+            if ev[0] > cursor:
+                out.append(ev)
+                cursor = ev[0]
+        return out, cursor
+
+    def event_cursor(self, group: int) -> int:
+        """Current newest event seq for ``group`` (start-of-life cursor)."""
+        if self._groups is None:
+            return -1
+        evs = self._groups.events.get(group, [])
+        return evs[-1][0] if evs else -1
+
+
+class _Held:
+    """Retained commit + optional host-side value + TTL timer.
+
+    Mirrors the CPU machines' retained-commit discipline
+    (``collections/state.py``): the commit is cleaned exactly when its
+    effect is superseded, keeping CPU-log compaction correct while the
+    value itself lives on device (``on_device=True``) or host-side
+    (shadow overflow / non-int32 payloads).
+    """
+
+    __slots__ = ("commit", "value", "on_device", "timer")
+
+    def __init__(self, commit: Commit, value: Any = None,
+                 on_device: bool = False):
+        self.commit = commit
+        self.value = value
+        self.on_device = on_device
+        self.timer = None
+
+    def discard(self) -> None:
+        if self.timer is not None:
+            self.timer.cancel()
+            self.timer = None
+        self.commit.clean()
+
+
+class DeviceBackedStateMachine(ResourceStateMachine):
+    """Base for state machines whose data plane is a device group."""
+
+    def __init__(self, engine: DeviceEngine, group: int) -> None:
+        super().__init__()
+        self._eng = engine
+        self._group = group
+        # skip events addressed to a predecessor resource of this group
+        self._ev_cursor = engine.event_cursor(group)
+
+    def _cmd(self, opcode: int, a: int = 0, b: int = 0, c: int = 0) -> int:
+        return self._eng.command(self._group, opcode, a, b, c)
+
+    def _qry(self, opcode: int, a: int = 0, b: int = 0, c: int = 0) -> int:
+        return self._eng.query(self._group, opcode, a, b, c)
+
+    def _events(self) -> list:
+        evs, self._ev_cursor = self._eng.take_events(
+            self._group, self._ev_cursor)
+        return evs
+
+    def delete(self) -> None:
+        self._eng.release(self._group)
+
+
+# ---------------------------------------------------------------------------
+# value / long
+# ---------------------------------------------------------------------------
+
+class DeviceAtomicValueState(DeviceBackedStateMachine):
+    """Linearizable register: int32 values live in the device register;
+    ``None``/non-int32 payloads shadow host-side (semantics identical to
+    ``AtomicValueState`` — reference ``AtomicValueState.java:32``)."""
+
+    _UNSET = object()
+
+    def __init__(self, engine: DeviceEngine, group: int) -> None:
+        super().__init__(engine, group)
+        self._held: _Held | None = None      # None = register unset
+        self._shadow: Any = self._UNSET      # host value when not on device
+        self._listeners: dict[int, Commit] = {}
+        self._timer = None
+
+    # -- current value -----------------------------------------------------
+
+    def _value(self) -> Any:
+        if self._held is None:
+            return None
+        if self._held.on_device:
+            return self._qry(ops().OP_VALUE_GET)
+        return self._held.value
+
+    def _set_current(self, commit: Commit, value: Any,
+                     ttl: float | None) -> Any:
+        """Install ``value``; returns the previous value. One device
+        command at most (GET_AND_SET covers the device→device case)."""
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        was_device = self._held is not None and self._held.on_device
+        if self._held is not None:
+            previous_host = None if was_device else self._held.value
+            self._held.discard()
+        else:
+            previous_host = None
+        if _devint(value):
+            previous_dev = self._cmd(ops().OP_VALUE_GET_AND_SET, value)
+            previous = previous_dev if was_device else previous_host
+            self._held = _Held(commit, on_device=True)
+        else:
+            if was_device:
+                previous = self._cmd(ops().OP_VALUE_GET_AND_SET, 0)
+            else:
+                previous = previous_host
+            self._held = _Held(commit, value=value)
+        if ttl:
+            held = self._held
+
+            def expire() -> None:
+                if self._held is held:
+                    self._clear_value()
+                    self._publish_change(None)
+
+            self._timer = self.executor.schedule(ttl, expire)
+        return previous
+
+    def _clear_value(self) -> None:
+        if self._held is not None:
+            if self._held.on_device:
+                self._cmd(ops().OP_VALUE_SET, 0)
+            self._held.discard()
+            self._held = None
+        self._timer = None
+
+    # -- handlers ----------------------------------------------------------
+
+    def get(self, commit: Commit[vc.Get]) -> Any:
+        try:
+            return self._value()
+        finally:
+            commit.close()
+
+    def set(self, commit: Commit[vc.Set]) -> None:
+        op = commit.operation
+        previous = self._set_current(commit, op.value, op.ttl)
+        if previous != op.value:
+            self._publish_change(op.value)
+
+    def get_and_set(self, commit: Commit[vc.GetAndSet]) -> Any:
+        op = commit.operation
+        previous = self._set_current(commit, op.value, op.ttl)
+        if previous != op.value:
+            self._publish_change(op.value)
+        return previous
+
+    def compare_and_set(self, commit: Commit[vc.CompareAndSet]) -> bool:
+        op = commit.operation
+        if (self._held is not None and self._held.on_device
+                and _devint(op.expect) and _devint(op.update)):
+            # single device CAS — the hot path (BASELINE config #1)
+            if self._cmd(ops().OP_VALUE_CAS, op.expect, op.update):
+                self._held.discard()
+                self._held = _Held(commit, on_device=True)
+                self._reschedule_ttl(op.ttl)
+                if op.update != op.expect:
+                    self._publish_change(op.update)
+                return True
+            commit.clean()
+            return False
+        if self._value() == op.expect:
+            self._set_current(commit, op.update, op.ttl)
+            if op.update != op.expect:
+                self._publish_change(op.update)
+            return True
+        commit.clean()
+        return False
+
+    def _reschedule_ttl(self, ttl: float | None) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        if ttl:
+            held = self._held
+
+            def expire() -> None:
+                if self._held is held:
+                    self._clear_value()
+                    self._publish_change(None)
+
+            self._timer = self.executor.schedule(ttl, expire)
+
+    # -- change listeners (same protocol as the CPU machine) ---------------
+
+    def listen(self, commit: Commit[vc.Listen]) -> None:
+        previous = self._listeners.get(commit.session.id)
+        if previous is not None:
+            previous.clean()
+        self._listeners[commit.session.id] = commit
+
+    def unlisten(self, commit: Commit[vc.Unlisten]) -> None:
+        previous = self._listeners.pop(commit.session.id, None)
+        if previous is not None:
+            previous.clean()
+        commit.clean()
+
+    def _publish_change(self, value: Any) -> None:
+        for listen_commit in list(self._listeners.values()):
+            if listen_commit.session.is_open:
+                listen_commit.session.publish("change", value)
+
+    def close(self, session: Any) -> None:
+        listen_commit = self._listeners.pop(session.id, None)
+        if listen_commit is not None:
+            listen_commit.clean()
+
+    def delete(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        if self._held is not None:
+            if self._held.on_device:
+                self._cmd(ops().OP_VALUE_SET, 0)  # reset for group reuse
+            self._held.discard()
+            self._held = None
+        for listen_commit in self._listeners.values():
+            listen_commit.clean()
+        self._listeners.clear()
+        super().delete()
+
+
+# ---------------------------------------------------------------------------
+# map
+# ---------------------------------------------------------------------------
+
+class DeviceMapState(DeviceBackedStateMachine):
+    """Hashed map: int32 (key, value) entries live in the device probe
+    table; overflow and non-int32 payloads take the host shadow — a put
+    into a full device pool SUCCEEDS transparently (SURVEY.md §7.3 #1
+    "eviction-to-host for overflow"; the reference ``MapState.java:32``
+    has no capacity bound, so neither may we)."""
+
+    def __init__(self, engine: DeviceEngine, group: int) -> None:
+        super().__init__(engine, group)
+        # key -> _Held; on_device=True ⇒ value lives in the device table
+        self._held: dict[Any, _Held] = {}
+
+    # -- internals ---------------------------------------------------------
+
+    def _store(self, key: Any, value: Any, commit: Commit,
+               ttl: float | None) -> Any:
+        """Insert/overwrite ``key``; returns the previous value."""
+        previous_held = self._held.get(key)
+        previous = self._read(key)
+        if previous_held is not None:
+            on_device = previous_held.on_device
+            previous_held.discard()
+        else:
+            on_device = False
+        if on_device:
+            if _devint(value):
+                self._cmd(ops().OP_MAP_PUT, key, value)
+                held = _Held(commit, on_device=True)
+            else:
+                self._cmd(ops().OP_MAP_REMOVE, key)
+                held = _Held(commit, value=value)
+        else:
+            if (previous_held is None and _devint(key) and _devint(value)
+                    and self._cmd(ops().OP_MAP_PUT, key, value) != FAIL()):
+                held = _Held(commit, on_device=True)
+            else:
+                held = _Held(commit, value=value)
+        self._held[key] = held
+        if ttl:
+            def expire() -> None:
+                current = self._held.get(key)
+                if current is held:
+                    self._evict(key, held)
+
+            held.timer = self.executor.schedule(ttl, expire)
+        return previous
+
+    def _read(self, key: Any) -> Any:
+        held = self._held.get(key)
+        if held is None:
+            return None
+        if held.on_device:
+            return self._qry(ops().OP_MAP_GET, key)
+        return held.value
+
+    def _evict(self, key: Any, held: _Held) -> None:
+        del self._held[key]
+        if held.on_device:
+            self._cmd(ops().OP_MAP_REMOVE, key)
+        held.discard()
+
+    # -- queries -----------------------------------------------------------
+
+    def contains_key(self, commit: Commit[cc.MapContainsKey]) -> bool:
+        try:
+            return commit.operation.key in self._held
+        finally:
+            commit.close()
+
+    def contains_value(self, commit: Commit[cc.MapContainsValue]) -> bool:
+        try:
+            value = commit.operation.value
+            if _devint(value) and any(
+                    h.on_device for h in self._held.values()):
+                if self._qry(ops().OP_MAP_CONTAINS_VALUE, value):
+                    return True
+            return any((not h.on_device) and h.value == value
+                       for h in self._held.values())
+        finally:
+            commit.close()
+
+    def get(self, commit: Commit[cc.MapGet]) -> Any:
+        try:
+            return self._read(commit.operation.key)
+        finally:
+            commit.close()
+
+    def get_or_default(self, commit: Commit[cc.MapGetOrDefault]) -> Any:
+        try:
+            if commit.operation.key in self._held:
+                return self._read(commit.operation.key)
+            return commit.operation.default
+        finally:
+            commit.close()
+
+    def is_empty(self, commit: Commit[cc.MapIsEmpty]) -> bool:
+        try:
+            return not self._held
+        finally:
+            commit.close()
+
+    def size(self, commit: Commit[cc.MapSize]) -> int:
+        try:
+            return len(self._held)
+        finally:
+            commit.close()
+
+    # -- commands ----------------------------------------------------------
+
+    def put(self, commit: Commit[cc.MapPut]) -> Any:
+        op = commit.operation
+        return self._store(op.key, op.value, commit, op.ttl)
+
+    def put_if_absent(self, commit: Commit[cc.MapPutIfAbsent]) -> Any:
+        op = commit.operation
+        if op.key in self._held:
+            value = self._read(op.key)
+            commit.clean()
+            return value
+        self._store(op.key, op.value, commit, op.ttl)
+        return None
+
+    def remove(self, commit: Commit[cc.MapRemove]) -> Any:
+        key = commit.operation.key
+        commit.clean()
+        held = self._held.get(key)
+        if held is None:
+            return None
+        value = self._read(key)
+        self._evict(key, held)
+        return value
+
+    def remove_if_present(self, commit: Commit[cc.MapRemoveIfPresent]) -> bool:
+        op = commit.operation
+        commit.clean()
+        held = self._held.get(op.key)
+        if held is None or self._read(op.key) != op.value:
+            return False
+        self._evict(op.key, held)
+        return True
+
+    def replace(self, commit: Commit[cc.MapReplace]) -> Any:
+        op = commit.operation
+        if op.key not in self._held:
+            commit.clean()
+            return None
+        return self._store(op.key, op.value, commit, op.ttl)
+
+    def replace_if_present(self, commit: Commit[cc.MapReplaceIfPresent]) -> bool:
+        op = commit.operation
+        if op.key not in self._held or self._read(op.key) != op.expect:
+            commit.clean()
+            return False
+        self._store(op.key, op.value, commit, op.ttl)
+        return True
+
+    def clear(self, commit: Commit[cc.MapClear]) -> None:
+        if any(h.on_device for h in self._held.values()):
+            self._cmd(ops().OP_MAP_CLEAR)
+        for held in self._held.values():
+            held.discard()
+        self._held.clear()
+        commit.clean()
+
+    def delete(self) -> None:
+        if any(h.on_device for h in self._held.values()):
+            self._cmd(ops().OP_MAP_CLEAR)  # reset for group reuse
+        for held in self._held.values():
+            held.discard()
+        self._held.clear()
+        super().delete()
+
+
+# ---------------------------------------------------------------------------
+# set
+# ---------------------------------------------------------------------------
+
+class DeviceSetState(DeviceBackedStateMachine):
+    """Set: int32 members live in the device probe table, overflow/non-int32
+    members shadow host-side (reference ``SetState.java:32``)."""
+
+    def __init__(self, engine: DeviceEngine, group: int) -> None:
+        super().__init__(engine, group)
+        self._held: dict[Any, _Held] = {}
+
+    def add(self, commit: Commit[cc.SetAdd]) -> bool:
+        op = commit.operation
+        if op.value in self._held:
+            commit.clean()
+            return False
+        if _devint(op.value) and self._cmd(
+                ops().OP_SET_ADD, op.value) not in (FAIL(), 0):
+            held = _Held(commit, on_device=True)
+        else:
+            held = _Held(commit, value=op.value)
+        self._held[op.value] = held
+        if op.ttl:
+            def expire() -> None:
+                if self._held.get(op.value) is held:
+                    self._evict(op.value, held)
+
+            held.timer = self.executor.schedule(op.ttl, expire)
+        return True
+
+    def _evict(self, value: Any, held: _Held) -> None:
+        del self._held[value]
+        if held.on_device:
+            self._cmd(ops().OP_SET_REMOVE, value)
+        held.discard()
+
+    def remove(self, commit: Commit[cc.SetRemove]) -> bool:
+        commit.clean()
+        held = self._held.get(commit.operation.value)
+        if held is None:
+            return False
+        self._evict(commit.operation.value, held)
+        return True
+
+    def contains(self, commit: Commit[cc.SetContains]) -> bool:
+        try:
+            return commit.operation.value in self._held
+        finally:
+            commit.close()
+
+    def is_empty(self, commit: Commit[cc.SetIsEmpty]) -> bool:
+        try:
+            return not self._held
+        finally:
+            commit.close()
+
+    def size(self, commit: Commit[cc.SetSize]) -> int:
+        try:
+            return len(self._held)
+        finally:
+            commit.close()
+
+    def clear(self, commit: Commit[cc.SetClear]) -> None:
+        if any(h.on_device for h in self._held.values()):
+            self._cmd(ops().OP_SET_CLEAR)
+        for held in self._held.values():
+            held.discard()
+        self._held.clear()
+        commit.clean()
+
+    def delete(self) -> None:
+        if any(h.on_device for h in self._held.values()):
+            self._cmd(ops().OP_SET_CLEAR)  # reset for group reuse
+        for held in self._held.values():
+            held.discard()
+        self._held.clear()
+        super().delete()
+
+
+# ---------------------------------------------------------------------------
+# queue
+# ---------------------------------------------------------------------------
+
+class DeviceQueueState(DeviceBackedStateMachine):
+    """FIFO queue: device ring holds int32 payloads, a host marker deque
+    defines global order across device/host entries so interleaved
+    overflow keeps exact FIFO semantics (reference ``QueueState.java:30``).
+
+    Values are mirrored host-side so ``contains``/``remove(v)`` (which the
+    device ring cannot serve from the middle) stay supported: a mid-ring
+    removal drains and re-offers the ring minus the removed payload
+    (``_tombstone_device``) — queue remove-by-value is rare, ring
+    capacity small.
+    """
+
+    def __init__(self, engine: DeviceEngine, group: int) -> None:
+        super().__init__(engine, group)
+        self._queue: deque[_Held] = deque()  # live entries, global FIFO
+
+    def _enqueue(self, commit: Commit, value: Any) -> bool:
+        if _devint(value) and self._cmd(ops().OP_Q_OFFER, value) == 1:
+            held = _Held(commit, value=value, on_device=True)
+        else:
+            held = _Held(commit, value=value)
+        self._queue.append(held)
+        return True
+
+    def _device_poll(self) -> int:
+        return self._cmd(ops().OP_Q_POLL)
+
+    def _pop_head(self) -> _Held:
+        held = self._queue.popleft()
+        if held.on_device:
+            self._device_poll()
+        held.discard()
+        return held
+
+    def add(self, commit: Commit[cc.QueueAdd]) -> bool:
+        return self._enqueue(commit, commit.operation.value)
+
+    def offer(self, commit: Commit[cc.QueueOffer]) -> bool:
+        return self._enqueue(commit, commit.operation.value)
+
+    def peek(self, commit: Commit[cc.QueuePeek]) -> Any:
+        try:
+            return self._queue[0].value if self._queue else None
+        finally:
+            commit.close()
+
+    def poll(self, commit: Commit[cc.QueuePoll]) -> Any:
+        commit.clean()
+        if not self._queue:
+            return None
+        return self._pop_head().value
+
+    def element(self, commit: Commit[cc.QueueElement]) -> Any:
+        commit.clean()
+        if not self._queue:
+            raise ValueError("queue is empty")
+        return self._queue[0].value
+
+    def remove(self, commit: Commit[cc.QueueRemove]) -> Any:
+        op = commit.operation
+        commit.clean()
+        if op.value is None:
+            if not self._queue:
+                raise ValueError("queue is empty")
+            return self._pop_head().value
+        for held in self._queue:
+            if held.value == op.value:
+                if held is self._queue[0]:
+                    self._pop_head()
+                else:
+                    # mid-queue: tombstone; the device copy (if any) is
+                    # drained when it reaches the ring head
+                    self._queue.remove(held)
+                    if held.on_device:
+                        self._tombstone_device(held)
+                    held.discard()
+                return True
+        return False
+
+    def _tombstone_device(self, held: _Held) -> None:
+        # Re-synchronize the ring with the live deque: device entries
+        # before this one are still live; we pop-and-reoffer the ring so
+        # the removed payload is dropped. Device ring order == order of
+        # on_device entries in self._queue, so draining/refilling keeps it.
+        live_device = [h.value for h in self._queue if h.on_device]
+        while self._device_poll() != FAIL():
+            pass
+        for v in live_device:
+            self._cmd(ops().OP_Q_OFFER, v)
+
+    def contains(self, commit: Commit[cc.QueueContains]) -> bool:
+        try:
+            return any(h.value == commit.operation.value
+                       for h in self._queue)
+        finally:
+            commit.close()
+
+    def is_empty(self, commit: Commit[cc.QueueIsEmpty]) -> bool:
+        try:
+            return not self._queue
+        finally:
+            commit.close()
+
+    def size(self, commit: Commit[cc.QueueSize]) -> int:
+        try:
+            return len(self._queue)
+        finally:
+            commit.close()
+
+    def clear(self, commit: Commit[cc.QueueClear]) -> None:
+        if any(h.on_device for h in self._queue):
+            self._cmd(ops().OP_Q_CLEAR)
+        for held in self._queue:
+            held.discard()
+        self._queue.clear()
+        commit.clean()
+
+    def delete(self) -> None:
+        if any(h.on_device for h in self._queue):
+            self._cmd(ops().OP_Q_CLEAR)  # reset for group reuse
+        for held in self._queue:
+            held.discard()
+        self._queue.clear()
+        super().delete()
+
+
+# ---------------------------------------------------------------------------
+# lock
+# ---------------------------------------------------------------------------
+
+class DeviceLockState(DeviceBackedStateMachine):
+    """Mutex on the device lock kernel: waiter id = the Lock commit index
+    (unique per acquire, same as the CPU machine), grants delivered as
+    "lock" session events when the device emits EV_LOCK_GRANT.
+
+    Timeouts run host-side through the replicated log-time timers and
+    resolve the grant-vs-timeout race via OP_LOCK_CANCEL (totally ordered
+    in the device log). Session death releases held locks and dequeues
+    waiters — the capability fix over the reference, preserved from the
+    CPU machine (``coordination/state.py:21-23``).
+    """
+
+    def __init__(self, engine: DeviceEngine, group: int) -> None:
+        super().__init__(engine, group)
+        self._waiters: dict[int, Commit] = {}   # waiter id -> Lock commit
+        self._holder_id: int | None = None
+        self._timers: dict[int, Any] = {}
+        self._overflow: deque[int] = deque()    # ids the device ring rejected
+
+    # -- event pump --------------------------------------------------------
+
+    def _pump(self) -> None:
+        for _seq, code, target, _arg in self._events():
+            if code != ops().EV_LOCK_GRANT:
+                continue
+            waiter = self._waiters.get(target)
+            if waiter is None:
+                # grant to a dead waiter (cancelled/closed): release it so
+                # the queue keeps moving
+                self._cmd(ops().OP_LOCK_RELEASE, target)
+                continue
+            self._holder_id = target
+            timer = self._timers.pop(target, None)
+            if timer is not None:
+                timer.cancel()
+            if waiter.session.is_open:
+                waiter.session.publish(
+                    "lock", {"id": target, "acquired": True})
+        self._flush_overflow()
+
+    def _flush_overflow(self) -> None:
+        while self._overflow:
+            wid = self._overflow[0]
+            if wid not in self._waiters:
+                self._overflow.popleft()
+                continue
+            result = self._cmd(ops().OP_LOCK_ACQUIRE, wid, -1)
+            if result == 1:  # granted immediately
+                self._overflow.popleft()
+                self._on_grant(wid)
+            elif result == 2:  # queued on device
+                self._overflow.popleft()
+            else:  # ring still full
+                break
+
+    def _on_grant(self, wid: int) -> None:
+        waiter = self._waiters.get(wid)
+        self._holder_id = wid
+        timer = self._timers.pop(wid, None)
+        if timer is not None:
+            timer.cancel()
+        if waiter is not None and waiter.session.is_open:
+            waiter.session.publish("lock", {"id": wid, "acquired": True})
+
+    # -- handlers ----------------------------------------------------------
+
+    def lock(self, commit: Commit[oc.Lock]) -> int:
+        wid = commit.index
+        timeout = commit.operation.timeout
+        self._pump()
+        if timeout == 0:
+            result = self._cmd(ops().OP_LOCK_ACQUIRE, wid, 0)
+            if result == 1:
+                self._waiters[wid] = commit
+                self._on_grant(wid)
+            else:
+                commit.session.publish(
+                    "lock", {"id": wid, "acquired": False})
+                commit.clean()
+            self._pump()
+            return wid
+        self._waiters[wid] = commit
+        if self._overflow:
+            self._overflow.append(wid)  # preserve FIFO behind overflow
+        else:
+            result = self._cmd(ops().OP_LOCK_ACQUIRE, wid, -1)
+            if result == 1:
+                self._on_grant(wid)
+            elif result == 0:  # device wait ring full — host absorbs
+                self._overflow.append(wid)
+        if timeout and timeout > 0 and self._holder_id != wid:
+            def expire() -> None:
+                self._timers.pop(wid, None)
+                self._cancel_waiter(wid, publish=True)
+
+            self._timers[wid] = self.executor.schedule(timeout, expire)
+        self._pump()
+        return wid
+
+    def _cancel_waiter(self, wid: int, publish: bool) -> None:
+        waiter = self._waiters.get(wid)
+        if waiter is None or self._holder_id == wid:
+            return
+        if wid in self._overflow:
+            self._overflow.remove(wid)
+            outcome = 1
+        else:
+            outcome = self._cmd(ops().OP_LOCK_CANCEL, wid)
+        if outcome == 2:
+            # race resolved in our favor: already granted — the grant
+            # event is (or will be) in the pump
+            self._pump()
+            return
+        del self._waiters[wid]
+        if publish and waiter.session.is_open:
+            waiter.session.publish("lock", {"id": wid, "acquired": False})
+        waiter.clean()
+        self._pump()
+
+    def unlock(self, commit: Commit[oc.Unlock]) -> None:
+        try:
+            self._pump()
+            if self._holder_id is None:
+                return
+            holder = self._waiters.get(self._holder_id)
+            if holder is None or holder.session.id != commit.session.id:
+                raise ValueError("not the lock holder")
+            self._release_holder()
+        finally:
+            commit.clean()
+
+    def _release_holder(self) -> None:
+        wid = self._holder_id
+        holder = self._waiters.pop(wid, None)
+        self._holder_id = None
+        if holder is not None:
+            holder.clean()
+        self._cmd(ops().OP_LOCK_RELEASE, wid)
+        self._pump()
+
+    # -- session lifecycle -------------------------------------------------
+
+    def close(self, session: Any) -> None:
+        self._pump()
+        for wid in [w for w, c in self._waiters.items()
+                    if c.session.id == session.id and w != self._holder_id]:
+            self._cancel_waiter(wid, publish=False)
+        if self._holder_id is not None:
+            holder = self._waiters.get(self._holder_id)
+            if holder is not None and holder.session.id == session.id:
+                self._release_holder()
+
+    def delete(self) -> None:
+        for timer in self._timers.values():
+            timer.cancel()
+        self._timers.clear()
+        # Reset the device lock for group reuse: dequeue every waiter
+        # FIRST so releasing the holder cannot grant one of them.
+        for wid in list(self._waiters):
+            if wid != self._holder_id and wid not in self._overflow:
+                self._cmd(ops().OP_LOCK_CANCEL, wid)
+        if self._holder_id is not None:
+            self._cmd(ops().OP_LOCK_RELEASE, self._holder_id)
+            self._holder_id = None
+        for waiter in self._waiters.values():
+            waiter.clean()
+        self._waiters.clear()
+        self._overflow.clear()
+        super().delete()
+
+
+# ---------------------------------------------------------------------------
+# leader election
+# ---------------------------------------------------------------------------
+
+class DeviceLeaderElectionState(DeviceBackedStateMachine):
+    """Leader election on the device election kernel: candidate id = the
+    client session id (CPU machine keys listeners by session), epoch =
+    device log index of the winning listen (an opaque fencing token to the
+    client, exactly as the reference's commit-index epoch,
+    ``LeaderElectionState.java:31``)."""
+
+    def __init__(self, engine: DeviceEngine, group: int) -> None:
+        super().__init__(engine, group)
+        self._listens: dict[int, Commit] = {}   # session id -> Listen commit
+        self._leader: int | None = None         # session id
+        self._epoch: int | None = None
+        self._overflow: deque[int] = deque()
+
+    def _pump(self) -> None:
+        for _seq, code, target, arg in self._events():
+            if code != ops().EV_ELECT:
+                continue
+            listen = self._listens.get(target)
+            if listen is None:
+                # promoted a dead candidate: resign it to move succession
+                self._cmd(ops().OP_ELECT_RESIGN, target)
+                continue
+            self._leader, self._epoch = target, arg
+            if listen.session.is_open:
+                listen.session.publish("elect", arg)
+        self._flush_overflow()
+
+    def _flush_overflow(self) -> None:
+        while self._overflow:
+            sid = self._overflow[0]
+            if sid not in self._listens:
+                self._overflow.popleft()
+                continue
+            result = self._cmd(ops().OP_ELECT_LISTEN, sid)
+            if result == FAIL():
+                break  # listener ring still full
+            self._overflow.popleft()
+            if result > 0:
+                self._on_elected(sid, result)
+
+    def _on_elected(self, sid: int, epoch: int) -> None:
+        self._leader, self._epoch = sid, epoch
+        listen = self._listens.get(sid)
+        if listen is not None and listen.session.is_open:
+            listen.session.publish("elect", epoch)
+
+    def listen(self, commit: Commit[oc.ElectionListen]) -> None:
+        sid = commit.session.id
+        self._pump()
+        previous = self._listens.get(sid)
+        if previous is not None:
+            previous.clean()
+            self._listens[sid] = commit
+            self._pump()
+            return
+        self._listens[sid] = commit
+        if self._overflow:
+            self._overflow.append(sid)
+        else:
+            result = self._cmd(ops().OP_ELECT_LISTEN, sid)
+            if result == FAIL():
+                self._overflow.append(sid)  # host absorbs ring overflow
+            elif result > 0:
+                self._on_elected(sid, result)
+        self._pump()
+
+    def unlisten(self, commit: Commit[oc.ElectionUnlisten]) -> None:
+        try:
+            self._resign(commit.session.id)
+        finally:
+            commit.clean()
+
+    def is_leader(self, commit: Commit[oc.ElectionIsLeader]) -> bool:
+        # NO pump here: queries execute on a single server, and _pump can
+        # issue device commands (overflow flush / dead-candidate resign)
+        # that would fork that server's device log from its peers. The
+        # mirror is always current as of the last command (every command
+        # settles its events before returning), which is exactly the
+        # linearization point a query may observe.
+        try:
+            return self._epoch is not None \
+                and self._epoch == commit.operation.epoch
+        finally:
+            commit.close()
+
+    def _resign(self, sid: int) -> None:
+        self._pump()
+        listen = self._listens.pop(sid, None)
+        if listen is None:
+            return
+        listen.clean()
+        if sid in self._overflow:
+            self._overflow.remove(sid)
+        else:
+            self._cmd(ops().OP_ELECT_RESIGN, sid)
+        if self._leader == sid:
+            self._leader = self._epoch = None
+        self._pump()
+
+    def close(self, session: Any) -> None:
+        self._resign(session.id)
+
+    def delete(self) -> None:
+        # Reset the device election for group reuse: unlist waiters first,
+        # resign the leader last (empty ring → no succession event).
+        for sid in list(self._listens):
+            if sid != self._leader and sid not in self._overflow:
+                self._cmd(ops().OP_ELECT_RESIGN, sid)
+        if self._leader is not None:
+            self._cmd(ops().OP_ELECT_RESIGN, self._leader)
+            self._leader = self._epoch = None
+        for listen in self._listens.values():
+            listen.clean()
+        self._listens.clear()
+        self._overflow.clear()
+        super().delete()
+
+
+# ---------------------------------------------------------------------------
+# registry + lazy opcode access
+# ---------------------------------------------------------------------------
+
+def ops():
+    """The device opcode/event-code module, imported lazily so constructing
+    a pure-CPU cluster never imports JAX."""
+    from ..ops import apply as _apply
+    return _apply
+
+
+def FAIL() -> int:
+    return INT32_MIN
+
+
+def device_machine_for(machine_cls: type) -> type | None:
+    """Device-backed equivalent for a CPU state machine class, or ``None``
+    when the type must stay on the CPU path (multimap/topic/group/bus and
+    any user-defined machine)."""
+    from ..atomic.state import AtomicValueState
+    from ..collections.state import MapState, QueueState, SetState
+    from ..coordination.state import LeaderElectionState, LockState
+    return {
+        AtomicValueState: DeviceAtomicValueState,
+        MapState: DeviceMapState,
+        SetState: DeviceSetState,
+        QueueState: DeviceQueueState,
+        LockState: DeviceLockState,
+        LeaderElectionState: DeviceLeaderElectionState,
+    }.get(machine_cls)

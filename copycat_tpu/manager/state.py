@@ -97,15 +97,21 @@ class ManagerResourceExecutor(ResourceStateMachineExecutor):
 
 
 class ResourceHolder:
-    __slots__ = ("resource_id", "key", "state_machine", "executor")
+    __slots__ = ("resource_id", "key", "state_machine", "executor",
+                 "machine_cls")
 
     def __init__(self, resource_id: int, key: str,
                  state_machine: ResourceStateMachine,
-                 executor: ManagerResourceExecutor) -> None:
+                 executor: ManagerResourceExecutor,
+                 machine_cls: type | None = None) -> None:
         self.resource_id = resource_id
         self.key = key
         self.state_machine = state_machine
         self.executor = executor
+        # The LOGICAL machine class requested at create time — the actual
+        # instance may be its device-backed equivalent when the manager
+        # runs the TPU executor (device_executor.device_machine_for).
+        self.machine_cls = machine_cls or type(state_machine)
 
 
 class InstanceHolder:
@@ -137,13 +143,42 @@ class _ReparentedCommit(Commit):
 
 
 class ResourceManager(StateMachine):
-    """The single top-level state machine wired into every server."""
+    """The single top-level state machine wired into every server.
 
-    def __init__(self) -> None:
+    ``executor="tpu"`` routes the fixed-shape resource types
+    (value/long, map, set, queue, lock, leader election) to the in-process
+    device engine — one device Raft group per resource — with the CPU
+    state machines as the default and the automatic fallback for
+    unsupported types and engine exhaustion (SURVEY.md §7.1; selection
+    seam mirrors ``AtomixReplica.java:374``). The executor choice must be
+    uniform across the cluster, like ``withStateMachine`` in the reference.
+    """
+
+    def __init__(self, executor: str = "cpu",
+                 engine_config: Any | None = None) -> None:
         super().__init__()
+        if executor not in ("cpu", "tpu"):
+            raise ValueError(f"unknown executor {executor!r}")
         self.keys: dict[str, int] = {}
         self.resources: dict[int, ResourceHolder] = {}
         self.instances: dict[int, InstanceHolder] = {}
+        self.executor_kind = executor
+        self._engine: Any = None
+        self._engine_config = engine_config
+
+    @property
+    def device_engine(self) -> Any:
+        if self._engine is None and self.executor_kind == "tpu":
+            from .device_executor import DeviceEngine
+            self._engine = DeviceEngine(self._engine_config)
+        return self._engine
+
+    def prewarm(self) -> None:
+        """Build + jit-compile the device engine up front (called at server
+        open, before any client session exists — the first compile can take
+        tens of seconds and must not stall keep-alives mid-session)."""
+        if self.executor_kind == "tpu":
+            self.device_engine._ensure()
 
     # -- catalog ops -------------------------------------------------------
 
@@ -212,20 +247,34 @@ class ResourceManager(StateMachine):
         resource_id = self.keys.get(key)
         if resource_id is not None:
             holder = self.resources[resource_id]
-            if type(holder.state_machine) is not machine_cls:
+            if holder.machine_cls is not machine_cls:
                 commit.clean()
                 raise ValueError(
                     f"resource '{key}' exists with type "
-                    f"{type(holder.state_machine).__name__}, not {machine_cls.__name__}")
+                    f"{holder.machine_cls.__name__}, not {machine_cls.__name__}")
             return holder
         resource_id = commit.index
         self.keys[key] = resource_id
-        machine: ResourceStateMachine = machine_cls()
+        machine = self._instantiate_machine(machine_cls)
         executor = ManagerResourceExecutor(self.executor, resource_id, key)
         machine.init(executor)
-        holder = ResourceHolder(resource_id, key, machine, executor)
+        holder = ResourceHolder(resource_id, key, machine, executor,
+                                machine_cls=machine_cls)
         self.resources[resource_id] = holder
         return holder
+
+    def _instantiate_machine(self, machine_cls: type) -> ResourceStateMachine:
+        """CPU machine by default; its device-backed equivalent when the
+        TPU executor is selected, the type is device-eligible, and the
+        engine still has a free group (fallback otherwise)."""
+        if self.executor_kind == "tpu":
+            from .device_executor import device_machine_for
+            device_cls = device_machine_for(machine_cls)
+            if device_cls is not None:
+                group = self.device_engine.allocate()
+                if group is not None:
+                    return device_cls(self.device_engine, group)
+        return machine_cls()
 
     def _create_instance(self, commit: Commit, holder: ResourceHolder) -> InstanceHolder:
         instance_id = commit.index

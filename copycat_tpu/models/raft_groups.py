@@ -16,7 +16,7 @@ deterministic apply at batch scale.
 from __future__ import annotations
 
 from collections import deque
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any
 
 import jax
@@ -34,6 +34,16 @@ from ..ops.consensus import (
     query_step,
     step,
 )
+
+
+@lru_cache(maxsize=None)
+def _jitted_programs(config: Config):
+    """(step, query, install) jit wrappers shared across all RaftGroups
+    instances with the same static Config (Config is a hashable NamedTuple,
+    so it keys the cache; shapes are handled inside each jit wrapper)."""
+    return (jax.jit(partial(step, config=config)),
+            jax.jit(partial(query_step, config=config)),
+            jax.jit(partial(install_snapshots, config=config)))
 
 
 class RaftGroups:
@@ -69,9 +79,10 @@ class RaftGroups:
             _, self.deliver = shard_step_inputs(
                 self._empty_submits(), self.deliver, mesh)
 
-        self._step = jax.jit(partial(step, config=self.config))
-        self._query = jax.jit(partial(query_step, config=self.config))
-        self._install = jax.jit(partial(install_snapshots, config=self.config))
+        # Config-keyed jit cache: many RaftGroups instances with the same
+        # Config (e.g. one device engine per server in a multi-server test)
+        # share ONE compiled program instead of recompiling per instance.
+        self._step, self._query, self._install = _jitted_programs(self.config)
         self._queues: dict[int, deque] = {}
         self._query_queues: dict[int, deque] = {}
         self._next_tag = 1
@@ -184,6 +195,37 @@ class RaftGroups:
         if bool(np.asarray(out.stale).any()):
             self.state = self._install(self.state, out.stale, out.leader)
         return out
+
+    def serve_query(self, group: int, opcode: int, a: int = 0, b: int = 0,
+                    c: int = 0, max_attempts: int = 50) -> int:
+        """Serve ONE read-only op from the leader's applied state, never
+        touching the log (unlike :meth:`submit_query`, whose unserved
+        slots escalate to the command path and append an entry).
+
+        For callers that replicate the engine deterministically across
+        processes (the SPI device executor), log content must be a pure
+        function of the committed command stream — so the no-leader
+        fallback here only *steps* (advancing the clock, which no
+        resource state depends on) and retries; it never appends.
+        """
+        from ..ops.apply import QUERY_OPCODES
+        if opcode not in QUERY_OPCODES:
+            raise ValueError(
+                f"opcode {opcode} is not read-only; submit it as a command")
+        sub = self._empty_submits()
+        sub.opcode[group, 0] = opcode
+        sub.a[group, 0] = a
+        sub.b[group, 0] = b
+        sub.c[group, 0] = c
+        sub.valid[group, 0] = True
+        for _ in range(max_attempts):
+            results, served = self._query(self.state, sub)
+            if bool(np.asarray(served)[group, 0]):
+                self.metrics.counter("queries_served").inc()
+                return int(np.asarray(results)[group, 0])
+            self.step_round()  # no leader yet / applied < commit: settle
+        raise TimeoutError(
+            f"group {group} query unservable after {max_attempts} rounds")
 
     def _serve_queries(self) -> None:
         """Drain the query lane: serve from the leader's applied state; a
