@@ -1,0 +1,42 @@
+"""Console entry points (installed via ``[project.scripts]``).
+
+``copycat-server`` runs a standalone AtomixServer node — the packaged
+equivalent of the reference's standalone-server example
+(``StandaloneServerExample.java:27``); the runnable example in
+``examples/standalone_server.py`` delegates here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import tempfile
+
+
+async def _serve(argv: list[str]) -> None:
+    from .io.tcp import TcpTransport
+    from .io.transport import Address
+    from .manager.atomix import AtomixServer
+    from .server.log import Storage, StorageLevel
+
+    args = argv or ["127.0.0.1:5001"]
+    address = Address.parse(args[0])
+    members = [Address.parse(a) for a in args]
+
+    storage = Storage(StorageLevel.DISK,
+                      directory=tempfile.mkdtemp(prefix="copycat-tpu-"),
+                      max_entries_per_segment=16)
+    server = (AtomixServer.builder(address, members)
+              .with_transport(TcpTransport())
+              .with_storage(storage)
+              .build())
+    await server.open()
+    print(f"server listening at {address} (log: {storage.directory})")
+
+    while True:
+        await asyncio.sleep(10)
+
+
+def server(argv: list[str] | None = None) -> None:
+    """``copycat-server host:port [peers...]``"""
+    asyncio.run(_serve(sys.argv[1:] if argv is None else argv))

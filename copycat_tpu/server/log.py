@@ -69,10 +69,43 @@ class _MappedSegment:
     CRC_SEED = 0xA5C3
 
     def __init__(self, path: str, capacity: int) -> None:
-        self._f = open(path, "w+b")
+        # Exclusive create: segments are named by the entry index that
+        # triggered the roll, so an unexpected name collision must fail
+        # loudly instead of silently truncating persisted frames (the DISK
+        # path is immune via "ab"; this keeps MAPPED equally safe).
+        self._f = open(path, "x+b")
         self._f.truncate(self.HEADER + capacity)
         self._mm = mmap.mmap(self._f.fileno(), 0)
         self._used = 0
+
+    @classmethod
+    def reopen(cls, path: str) -> "_MappedSegment":
+        """Reopen an existing segment for continued appends after recovery:
+        the write position resumes after the last CRC-valid frame and the
+        watermark is re-clamped to it.
+
+        The region between the resume point and the old watermark is
+        ZEROED AND FLUSHED before any append: it may still hold CRC-valid
+        stale frames (e.g. a torn tail the recovery discarded), and a later
+        crash whose writeback persisted an advanced watermark but not the
+        new frame bytes would otherwise resurrect them as a log prefix
+        that never existed (the same writeback-reordering class the CRC
+        framing defends against)."""
+        seg = cls.__new__(cls)
+        seg._f = open(path, "r+b")
+        seg._mm = mmap.mmap(seg._f.fileno(), 0)
+        old_mark = int.from_bytes(seg._mm[:cls.HEADER], "little")
+        used = 0
+        for payload in cls.read_payloads(path):
+            used += cls.FRAME_HEADER + len(payload)
+        seg._used = used
+        seg._mm[:cls.HEADER] = used.to_bytes(cls.HEADER, "little")
+        stale_end = min(cls.HEADER + old_mark, len(seg._mm))
+        if stale_end > cls.HEADER + used:
+            seg._mm[cls.HEADER + used:stale_end] = bytes(
+                stale_end - cls.HEADER - used)
+        seg._mm.flush()  # stale bytes must be gone before any new frame
+        return seg
 
     def append(self, payload: bytes) -> bool:
         """Copy a frame in; False when it doesn't fit (caller rolls over)."""
@@ -105,7 +138,10 @@ class _MappedSegment:
             length = int.from_bytes(data[pos:pos + 4], "little")
             crc = int.from_bytes(data[pos + 4:pos + 8], "little")
             payload = data[pos + 8:pos + 8 + length]
-            if (length == 0 or len(payload) < length
+            # The seeded CRC alone separates "torn" from "empty":
+            # crc32(b"", CRC_SEED) != 0, so an all-zero torn frame fails
+            # while a legitimately zero-length payload still validates.
+            if (len(payload) < length
                     or zlib.crc32(payload, _MappedSegment.CRC_SEED) != crc):
                 break  # torn tail: everything before it is intact
             payloads.append(payload)
@@ -398,6 +434,8 @@ class Log:
             stem, dot, ext = fname.rpartition(".")
             if ext in ("seg", "mseg"):
                 segments.append((int(stem[len(self._name) + 1:]), fname, ext))
+        last_path = last_ext = None
+        last_count = 0
         for _, fname, ext in sorted(segments):
             path = os.path.join(directory, fname)
             if ext == "mseg":
@@ -408,6 +446,7 @@ class Log:
                 payloads = []
                 while buf.remaining > 0:
                     payloads.append(buf.read_bytes())
+            last_path, last_ext, last_count = path, ext, len(payloads)
             for payload in payloads:
                 entry = self._serializer.read(payload)
                 # Replayed entries keep their persisted indices.  Gap-filled
@@ -421,6 +460,15 @@ class Log:
                     # Overwrite (post-truncate rewrite)
                     self._entries[entry.index - self._offset] = entry
                 self._note_term(entry.index, entry.term)
+        # Reopen the newest segment for continued appends so repeated
+        # restarts don't accumulate one near-empty segment per run.
+        if last_path is not None \
+                and last_count < self._storage.max_entries_per_segment:
+            if last_ext == "mseg":
+                self._mapped = _MappedSegment.reopen(last_path)
+            else:
+                self._segment_file = open(last_path, "ab")
+            self._segment_count = last_count
 
     def close(self) -> None:
         if self._segment_file is not None:

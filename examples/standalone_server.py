@@ -2,39 +2,12 @@
 a pure server node with disk storage and small segments.
 
     python examples/standalone_server.py 127.0.0.1:5001 [peers...]
+
+The logic lives in :mod:`copycat_tpu.cli` (also installed as the
+``copycat-server`` console script).
 """
 
-import asyncio
-import sys
-import tempfile
-
-from copycat_tpu.io.tcp import TcpTransport
-from copycat_tpu.io.transport import Address
-from copycat_tpu.manager.atomix import AtomixServer
-from copycat_tpu.server.log import Storage, StorageLevel
-
-
-async def main() -> None:
-    args = sys.argv[1:] or ["127.0.0.1:5001"]
-    address = Address.parse(args[0])
-    members = [Address.parse(a) for a in args]
-
-    storage = Storage(StorageLevel.DISK,
-                      directory=tempfile.mkdtemp(prefix="copycat-tpu-"),
-                      max_entries_per_segment=16)
-    server = (AtomixServer.builder(address, members)
-              .with_transport(TcpTransport())
-              .with_storage(storage)
-              .build())
-    await server.open()
-    print(f"server listening at {address} (log: {storage.directory})")
-
-    while True:
-        await asyncio.sleep(10)
-
-
-def run() -> None:
-    asyncio.run(main())
+from copycat_tpu.cli import server as run
 
 
 if __name__ == "__main__":

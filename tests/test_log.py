@@ -142,3 +142,26 @@ def test_mapped_crc_bounds_reordered_writeback(tmp_path):
     recovered2 = storage2.build_log()
     assert recovered2.last_index == 5
     assert recovered2.get(5).operation == "op-4"
+
+
+def test_recover_reopens_last_segment_no_small_segment_buildup(tmp_path):
+    """Repeated restarts must not roll one near-empty segment per run: the
+    newest segment is reopened for continued appends (DISK via append mode,
+    MAPPED via watermark-resumed mmap) when it still has entry budget."""
+    for level, ext in ((StorageLevel.DISK, "seg"), (StorageLevel.MAPPED, "mseg")):
+        directory = str(tmp_path / ext)
+        os.makedirs(directory)
+        storage = Storage(level, directory, max_entries_per_segment=8)
+        log = storage.build_log()
+        _fill(log, 3)
+        log.close()
+        before = len(_segments(directory, ext))
+        for _ in range(3):  # restart + append, 3 times
+            log = storage.build_log()
+            _fill(log, 1, term=2)
+            log.close()
+        assert len(_segments(directory, ext)) == before, ext
+        recovered = storage.build_log()
+        assert recovered.last_index == 6, ext
+        assert recovered.get(6).term == 2, ext
+        assert recovered.get(2).operation == "op-1", ext
