@@ -39,11 +39,20 @@ def _check_value(v: int) -> int:
 
 
 class DeviceResource:
-    """Base: one facade = one group of the batch."""
+    """Base: one facade = one group of the batch.
 
-    def __init__(self, groups: "raft_groups.RaftGroups", group: int) -> None:
+    ``session`` (a :class:`~copycat_tpu.models.sessions.DeviceSession`)
+    binds the facade to a device-path client identity: every call
+    keep-alives it, a dead session raises instead of operating, and —
+    for locks/elections — the session's id is the replicated holder/
+    candidate id so crash expiry can release through the log.
+    """
+
+    def __init__(self, groups: "raft_groups.RaftGroups", group: int,
+                 session=None) -> None:
         self._rg = groups
         self._group = group
+        self._session = session
         # Events buffered before this facade existed were addressed to
         # predecessor facades (reference semantic: session events die with
         # the session, ManagedResourceSession.java) — start the cursor past
@@ -67,18 +76,38 @@ class DeviceResource:
         self.consistency = level
         return self
 
-    def _call(self, opcode: int, a: int = 0, b: int = 0, c: int = 0) -> int:
-        tag = self._rg.submit(self._group, opcode, a, b, c)
-        self._rg.run_until([tag])
+    def _touch(self) -> None:
+        if self._session is not None:
+            self._session.keep_alive()  # raises when the session is dead
+
+    def _run_until(self, tag: int) -> int:
+        """Drive the batch until ``tag`` resolves, with the caller's
+        session pinned: a client blocked in its own call is alive, and
+        must not be expired by the very rounds its call is driving (the
+        commit could otherwise return success AFTER the registry released
+        the caller's locks)."""
+        registry = self._rg._sessions
+        if self._session is not None and registry is not None:
+            registry.pin(self._session.id)
+            try:
+                self._rg.run_until([tag])
+            finally:
+                registry.unpin(self._session.id)
+        else:
+            self._rg.run_until([tag])
         return self._rg.results.pop(tag)  # facade path stays bounded
+
+    def _call(self, opcode: int, a: int = 0, b: int = 0, c: int = 0) -> int:
+        self._touch()
+        return self._run_until(self._rg.submit(self._group, opcode, a, b, c))
 
     def _read(self, opcode: int, a: int = 0, b: int = 0, c: int = 0) -> int:
         """Route a read-only op by the configured consistency level."""
         if self.consistency == "atomic":
             return self._call(opcode, a, b, c)
-        tag = self._rg.submit_query(self._group, opcode, a, b, c)
-        self._rg.run_until([tag])
-        return self._rg.results.pop(tag)
+        self._touch()
+        return self._run_until(
+            self._rg.submit_query(self._group, opcode, a, b, c))
 
     def _checked(self, *args) -> int:
         result = self._call(*args)
@@ -234,11 +263,25 @@ class DeviceLock(DeviceResource):
     """Distributed mutex; grant arrives as a session event
     (DistributedLock.java:58 — completion via event, not command response).
 
-    ``holder_id`` identifies this client in the lock's wait queue (the
-    reference uses the client session id)."""
+    ``holder_id`` identifies this client in the lock's wait queue — pass a
+    ``session`` instead to use the session id (the reference's model:
+    lock state keyed by client session, auto-released on session death
+    via the registry's log-ordered expiry fan-out)."""
 
-    def __init__(self, groups, group, holder_id: int) -> None:
-        super().__init__(groups, group)
+    def __init__(self, groups, group, holder_id: int | None = None,
+                 session=None) -> None:
+        super().__init__(groups, group, session)
+        if session is not None:
+            # Death cleanup releases by session.id — a different manual
+            # holder_id would silently void the crash-release guarantee.
+            if holder_id is not None and holder_id != session.id:
+                raise ValueError(
+                    "pass either holder_id or session, not both: expiry "
+                    "cleanup is keyed by the session id")
+            holder_id = session.id
+            session.bind(group, "lock")
+        elif holder_id is None:
+            raise ValueError("DeviceLock needs a holder_id or a session")
         self.holder_id = holder_id
         # grants won via the cancel race (cancel result 2): the grant event
         # still arrives later and must not satisfy a future acquire attempt
@@ -256,6 +299,7 @@ class DeviceLock(DeviceResource):
     def _await_grant(self, deadline_clock: int | None,
                      max_rounds: int = 500) -> bool:
         for i in range(max_rounds):
+            self._touch()  # a blocked waiter is alive, not crashed
             if self._next_grant():
                 return True
             if i % 20 == 19:
@@ -305,8 +349,19 @@ class DeviceElection(DeviceResource):
     (DistributedLeaderElection.java:66 — epoch = commit index of the
     winning listen; ``is_leader(epoch)`` validates before fenced actions)."""
 
-    def __init__(self, groups, group, candidate_id: int) -> None:
-        super().__init__(groups, group)
+    def __init__(self, groups, group, candidate_id: int | None = None,
+                 session=None) -> None:
+        super().__init__(groups, group, session)
+        if session is not None:
+            if candidate_id is not None and candidate_id != session.id:
+                raise ValueError(
+                    "pass either candidate_id or session, not both: expiry "
+                    "cleanup is keyed by the session id")
+            candidate_id = session.id
+            session.bind(group, "election")
+        elif candidate_id is None:
+            raise ValueError(
+                "DeviceElection needs a candidate_id or a session")
         self.candidate_id = candidate_id
         self.epoch: int | None = None
         # promotions won but resigned before ever being polled: the elect

@@ -97,6 +97,16 @@ class RaftGroups:
         # deduped by absolute seq (ring re-delivers across leader changes)
         self.events: dict[int, list[tuple[int, int, int, int]]] = {}
         self._ev_seen: dict[int, int] = {}   # group -> highest seq consumed
+        self._sessions: Any = None           # lazy DeviceSessionRegistry
+
+    @property
+    def sessions(self):
+        """Device-path session registry (keep-alives + deterministic expiry
+        fan-out through the log — see ``models/sessions.py``)."""
+        if self._sessions is None:
+            from .sessions import DeviceSessionRegistry
+            self._sessions = DeviceSessionRegistry(self)
+        return self._sessions
 
     # -- op submission ---------------------------------------------------
 
@@ -194,6 +204,8 @@ class RaftGroups:
         # applied resource state) so they reconverge.
         if bool(np.asarray(out.stale).any()):
             self.state = self._install(self.state, out.stale, out.leader)
+        if self._sessions is not None:
+            self._sessions.tick()
         return out
 
     def serve_query(self, group: int, opcode: int, a: int = 0, b: int = 0,
