@@ -44,6 +44,7 @@ from copycat_tpu.ops.consensus import (
     current_leader,
     full_delivery,
     init_state,
+    install_snapshots,
     make_submits,
     query_step,
     step,
@@ -79,17 +80,27 @@ NORTH_STAR_OPS = 1_000_000.0
 USE_PALLAS = os.environ.get(
     "COPYCAT_BENCH_PALLAS",
     "1" if jax.default_backend() == "tpu" else "0") == "1"
+# Per-pool apply budgets (value,map,set,queue,lock,election): budgets
+# select the conflict-partitioned apply path (ops/consensus.py
+# Config.pool_budgets); empty = the single sequential scan.
+# - mixed: steady-state arrivals are value 2 / map 4 / set 2 / queue 4 /
+#   lock 2 / elect 2 per group per round; budgets give ~2x headroom so
+#   post-nemesis backlogs drain while cutting each pool's HBM traffic to
+#   budget/A of the sequential scan's.
+# - lock: full budgets — partitioning still wins 2.3x because the fully
+#   unrolled single-pool fold fuses the 16 applies into few HBM passes.
+# - counter/election/map: sequential scan measures equal or better
+#   (dispatch-bound or single-pool-dominant with value planes tiny).
+_full = str(max(4, SUBMIT_SLOTS))  # = applies_per_round, never a throttle
+_default_budgets = {"mixed": "4,6,4,6,4,4",
+                    "lock": ",".join([_full] * 6)}.get(SCENARIO, "")
+_budgets_env = os.environ.get("COPYCAT_BENCH_POOL_BUDGETS", _default_budgets)
+POOL_BUDGETS = (tuple(int(x) for x in _budgets_env.split(","))
+                if _budgets_env else None)
+
 # Set to a directory to capture an XLA profiler trace of the first timed
 # repetition (open in TensorBoard/XProf, or summarize with
 # copycat_tpu.utils.profiling.summarize_trace).
-# Fully unroll the apply loop on TPU: lax.scan blocks cross-iteration
-# fusion, so the scanned form streams every pool's state once per apply;
-# unrolled, XLA fuses consecutive applies into far fewer HBM passes
-# (mixed 100k x 5: 122 -> 52 ms/round, PERF.md). Costs ~30s extra compile.
-APPLY_UNROLL = int(os.environ.get(
-    "COPYCAT_BENCH_UNROLL",
-    str(max(4, SUBMIT_SLOTS)) if jax.default_backend() == "tpu" else "1"))
-
 PROFILE_DIR = os.environ.get("COPYCAT_BENCH_PROFILE", "")
 
 
@@ -218,7 +229,7 @@ def run_throughput(scenario: str) -> dict:
     config = Config(use_pallas=USE_PALLAS,
                     append_window=max(4, SUBMIT_SLOTS),
                     applies_per_round=max(4, SUBMIT_SLOTS),
-                    apply_unroll=APPLY_UNROLL,
+                    pool_budgets=POOL_BUDGETS,
                     resource=RESOURCE_CONFIGS.get(scenario, ResourceConfig()))
     key = jax.random.PRNGKey(0)
     key, init_key = jax.random.split(key)
@@ -236,11 +247,19 @@ def run_throughput(scenario: str) -> dict:
     victims = (isolation_masks(ROUNDS, GROUPS, PEERS, period=20, seed=1)
                if nemesis else None)
 
-    # Commit latency (BASELINE.md metric): rounds from leader log append to
-    # apply, histogrammed on device. Under nemesis an entry can wait out an
-    # isolation window beyond the ring size, so leave headroom past L; the
-    # top bucket is a saturation catch-all (warned about below if hit).
-    max_lat = LOG_SLOTS + 34
+    # Commit latency (BASELINE.md metric). DEFINITION: device-measured
+    # rounds from leader log APPEND to state-machine APPLY (+1 for the
+    # appending round), converted to ms at the measured round cadence.
+    # This is the replication+commit+apply cost; the host-observed
+    # submit->harvest latency adds host queueing on top (RaftGroups
+    # reports it in metrics "commit_latency_rounds" — see
+    # BENCH_SCENARIOS.md for both numbers side by side).
+    # Histogrammed on device with exact integer buckets; the histogram's
+    # one-hot compare scales with the bucket count, so only nemesis runs
+    # (whose entries can wait out isolation windows plus the whole
+    # backpressure ring) pay for the wide range. The top bucket is a
+    # saturation catch-all (warned about below if hit).
+    max_lat = LOG_SLOTS + (200 if nemesis else 34)
 
     def run(state, key):
         def body(carry, victim):
@@ -249,6 +268,17 @@ def run_throughput(scenario: str) -> dict:
             dl = (victim_deliver(victim, GROUPS, PEERS) if nemesis
                   else deliver)
             state, out = step(state, submits, dl, k, config=config)
+            if nemesis:
+                # Followers that fell beyond the ring window during an
+                # isolation can never be served by AppendEntries again;
+                # without the snapshot-install path (what RaftGroups does
+                # host-side) they accumulate until groups lose quorum and
+                # throughput decays run over run. Unconditional masked
+                # install fuses into the round; a lax.cond every-k-rounds
+                # variant measured 1.8x SLOWER (the cond blocks XLA's
+                # in-place aliasing of the full state).
+                state = install_snapshots(state, out.stale, out.leader,
+                                          config=config)
             lat = jnp.clip(out.out_latency.reshape(-1), 0, max_lat - 1)
             # one-hot select-reduce, NOT .at[].add(): XLA lowers the scatter
             # to an element-at-a-time DMA loop that costs more than the whole
@@ -376,7 +406,6 @@ def run_map_read() -> dict:
     reference's sub-ATOMIC query routing at batch scale."""
     config = Config(use_pallas=USE_PALLAS, append_window=max(4, SUBMIT_SLOTS),
                     applies_per_round=max(4, SUBMIT_SLOTS),
-                    apply_unroll=APPLY_UNROLL,
                     resource=RESOURCE_CONFIGS["map"])
     key = jax.random.PRNGKey(0)
     key, init_key = jax.random.split(key)

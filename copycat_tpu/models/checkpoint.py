@@ -59,6 +59,14 @@ def load(path: str | pathlib.Path, mesh=None):
         meta = json.loads(str(data["meta"]))
         cfg = dict(meta["config"])
         cfg["resource"] = ResourceConfig(**cfg["resource"])
+        # Tolerate snapshots from older Configs: drop fields that no
+        # longer exist (e.g. apply_unroll, removed with the conflict-
+        # partitioned apply) instead of failing the whole restore; new
+        # fields get their defaults. pool_budgets round-trips through
+        # JSON as a list — restore the hashable tuple.
+        cfg = {k: v for k, v in cfg.items() if k in Config._fields}
+        if isinstance(cfg.get("pool_budgets"), list):
+            cfg["pool_budgets"] = tuple(cfg["pool_budgets"])
         config = Config(**cfg)
         rg = RaftGroups(meta["num_groups"], meta["num_peers"],
                         log_slots=meta["log_slots"],

@@ -44,6 +44,7 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 INT_MIN = jnp.iinfo(jnp.int32).min
@@ -312,39 +313,49 @@ def _ring_compact(mask: jnp.ndarray, head, size, pos, live_arr, live_win,
 
 
 # ---------------------------------------------------------------------------
-# the apply kernel
+# pool classification (conflict partitioning)
 # ---------------------------------------------------------------------------
 
-def apply_entry(
-    res: ResourceState,
-    opcode: jnp.ndarray,  # [G,P] i32
-    a: jnp.ndarray,       # [G,P] i32
-    b: jnp.ndarray,       # [G,P] i32
-    c: jnp.ndarray,       # [G,P] i32
-    index: jnp.ndarray,   # [G,P] i32 — absolute log index of this entry
-    now: jnp.ndarray,     # [G,P] i32 — entry's logical timestamp
-    live: jnp.ndarray,    # [G,P] bool — entry exists and is being applied
-) -> tuple[ResourceState, jnp.ndarray]:
-    """Apply one committed entry per (group, replica) lane.
+#: Pool ids: entries in DIFFERENT pools commute (disjoint state), so the
+#: step's apply phase folds each pool's entries independently, touching
+#: only that pool's arrays (PERF.md "conflict-partitioned apply").
+POOL_VALUE, POOL_MAP, POOL_SET, POOL_QUEUE, POOL_LOCK, POOL_ELECT = range(6)
+NUM_POOLS = 6
+POOL_NONE = NUM_POOLS  # NoOps — applied (indices advance), no pool work
 
-    Returns ``(new_state, result)`` where ``result`` is the int32 command
-    response for the lane (meaningful only where ``live``). Session events
-    are pushed into the state's event ring.
-    """
-    # exactly one event per applied entry (grant/elect are mutually
-    # exclusive across opcodes), accumulated and pushed once at the end
-    ev_mask = jnp.zeros_like(live)
-    ev_code = jnp.zeros_like(opcode)
-    ev_target = jnp.zeros_like(opcode)
-    ev_arg = jnp.zeros_like(opcode)
-    result = jnp.zeros_like(opcode)
-    updates: dict = {}
 
+def pool_of(opcode: jnp.ndarray) -> jnp.ndarray:
+    """Map opcodes to pool ids ([G,P] -> [G,P], POOL_NONE for NoOp)."""
+    pool = jnp.full_like(opcode, POOL_NONE)
+    pool = jnp.where((opcode >= OP_VALUE_SET) & (opcode <= OP_LONG_ADD),
+                     POOL_VALUE, pool)
+    pool = jnp.where((opcode >= OP_MAP_PUT) & (opcode <= OP_MAP_CLEAR),
+                     POOL_MAP, pool)
+    pool = jnp.where((opcode >= OP_SET_ADD) & (opcode <= OP_SET_CLEAR),
+                     POOL_SET, pool)
+    pool = jnp.where((opcode >= OP_Q_OFFER) & (opcode <= OP_Q_CLEAR),
+                     POOL_QUEUE, pool)
+    pool = jnp.where((opcode >= OP_LOCK_ACQUIRE) & (opcode <= OP_LOCK_HOLDER),
+                     POOL_LOCK, pool)
+    pool = jnp.where((opcode >= OP_ELECT_LISTEN) & (opcode <= OP_ELECT_GET_EPOCH),
+                     POOL_ELECT, pool)
+    return pool
+
+
+# ---------------------------------------------------------------------------
+# per-pool apply kernels
+#
+# Each kernel applies ONE entry per (group, replica) lane against ONLY its
+# pool's arrays, so a scan over a pool's entries carries that pool's HBM
+# and nothing else. ``apply_entry`` below composes all six for the
+# single-entry case (query lane + CPU-oracle differential tests).
+# ---------------------------------------------------------------------------
+
+def apply_value(value, val_dl, opcode, a, b, c, now, live):
+    """Value/long registers; returns ((value, val_dl), result)."""
     def op(code):
         return live & (opcode == code)
 
-    # ---- value / long (always compiled in — two [G,P] planes) -------------
-    value, val_dl = res.value, res.val_dl
     expired = (val_dl > 0) & (val_dl <= now)
     eff = jnp.where(expired, 0, value)  # TTL'd value reads as unset
 
@@ -364,136 +375,164 @@ def apply_entry(
     new_value = jnp.where(cas_hit, b, new_value)
     new_value = jnp.where(is_gas, a, new_value)
     new_value = jnp.where(is_add, eff + a, new_value)
-    updates["value"] = jnp.where(wrote, new_value, jnp.where(purge, 0, value))
+    out_value = jnp.where(wrote, new_value, jnp.where(purge, 0, value))
     new_dl = jnp.where(is_set & (c > 0), now + c, 0)
-    updates["val_dl"] = jnp.where(wrote, new_dl, jnp.where(purge, 0, val_dl))
+    out_dl = jnp.where(wrote, new_dl, jnp.where(purge, 0, val_dl))
 
+    result = jnp.zeros_like(opcode)
     result = jnp.where(is_get, eff, result)
     result = jnp.where(is_cas, cas_hit.astype(jnp.int32), result)
     result = jnp.where(is_gas, eff, result)
     result = jnp.where(is_add, eff + a, result)
+    return (out_value, out_dl), result
 
-    # ---- map --------------------------------------------------------------
+
+def apply_map(mk, mv, ml, mdl, opcode, a, b, c, now, live):
+    """Hashed probe-table map; returns ((mk, mv, ml, mdl), result)."""
+    def op(code):
+        return live & (opcode == code)
+
     is_map = live & (opcode >= OP_MAP_PUT) & (opcode <= OP_MAP_CLEAR)
-    if res.map_key.shape[-1] > 0:
-        mk, mv, ml, mdl = res.map_key, res.map_val, res.map_live, res.map_dl
-        m_alive = ml & ((mdl == 0) | (mdl > now[..., None]))
-        hit = m_alive & (mk == a[..., None])
-        hit_idx, hit_any = _first_true(hit)
-        free_idx, free_any = _first_true(~m_alive)
-        old = jnp.where(hit_any, _gather3(mv, hit_idx), 0)
+    result = jnp.zeros_like(opcode)
+    if mk.shape[-1] == 0:
+        return (mk, mv, ml, mdl), jnp.where(is_map, INT_MIN, result)
 
-        put = op(OP_MAP_PUT)
-        pia = op(OP_MAP_PUT_IF_ABSENT)
-        rep = op(OP_MAP_REPLACE)
-        repif = op(OP_MAP_REPLACE_IF) & hit_any & (old == b)
-        write_new = (put | pia) & ~hit_any           # needs a free slot
-        write_over = (put & hit_any) | (rep & hit_any) | repif
-        ins_ok = write_new & free_any
-        w_idx = jnp.where(hit_any, hit_idx, free_idx)
-        w_val = jnp.where(repif, c, b)
-        w_dl = jnp.where((put | pia) & (c > 0), now + c, 0)
-        do_write = ins_ok | write_over
-        mk = _scatter3(mk, w_idx, do_write, a)
-        mv = _scatter3(mv, w_idx, do_write, w_val)
-        mdl = _scatter3(mdl, w_idx, do_write,
-                        jnp.where(write_over & ~put, 0, w_dl))
-        ml = _scatter3(ml, w_idx, do_write, jnp.ones_like(a, bool))
+    m_alive = ml & ((mdl == 0) | (mdl > now[..., None]))
+    hit = m_alive & (mk == a[..., None])
+    hit_idx, hit_any = _first_true(hit)
+    free_idx, free_any = _first_true(~m_alive)
+    old = jnp.where(hit_any, _gather3(mv, hit_idx), 0)
 
-        rm = op(OP_MAP_REMOVE) | (op(OP_MAP_REMOVE_IF) & (old == b))
-        ml = _scatter3(ml, hit_idx, rm & hit_any, jnp.zeros_like(a, bool))
-        ml = jnp.where(op(OP_MAP_CLEAR)[..., None], False, ml)
-        # drop expired slots whenever any map op touches the group (lazy
-        # purge; just-written slots have dl == 0 or dl > now, so they
-        # always survive)
-        ml = jnp.where(is_map[..., None],
-                       ml & ((mdl == 0) | (mdl > now[..., None])), ml)
-        updates.update(map_key=mk, map_val=mv, map_live=ml, map_dl=mdl)
+    put = op(OP_MAP_PUT)
+    pia = op(OP_MAP_PUT_IF_ABSENT)
+    rep = op(OP_MAP_REPLACE)
+    repif = op(OP_MAP_REPLACE_IF) & hit_any & (old == b)
+    write_new = (put | pia) & ~hit_any           # needs a free slot
+    write_over = (put & hit_any) | (rep & hit_any) | repif
+    ins_ok = write_new & free_any
+    w_idx = jnp.where(hit_any, hit_idx, free_idx)
+    w_val = jnp.where(repif, c, b)
+    w_dl = jnp.where((put | pia) & (c > 0), now + c, 0)
+    do_write = ins_ok | write_over
+    mk = _scatter3(mk, w_idx, do_write, a)
+    mv = _scatter3(mv, w_idx, do_write, w_val)
+    mdl = _scatter3(mdl, w_idx, do_write,
+                    jnp.where(write_over & ~put, 0, w_dl))
+    ml = _scatter3(ml, w_idx, do_write, jnp.ones_like(a, bool))
 
-        m_size = jnp.sum(m_alive, axis=-1).astype(jnp.int32)
-        result = jnp.where(put, old, result)
-        result = jnp.where(put & write_new & ~free_any, INT_MIN, result)
-        result = jnp.where(pia, jnp.where(hit_any, 0,
-                           jnp.where(free_any, 1, INT_MIN)), result)
-        result = jnp.where(op(OP_MAP_GET), old, result)
-        result = jnp.where(op(OP_MAP_GET_OR_DEFAULT),
-                           jnp.where(hit_any, old, b), result)
-        result = jnp.where(op(OP_MAP_REMOVE), old, result)
-        result = jnp.where(op(OP_MAP_REMOVE_IF),
-                           (hit_any & (old == b)).astype(jnp.int32), result)
-        result = jnp.where(rep, jnp.where(hit_any, old, INT_MIN), result)
-        result = jnp.where(op(OP_MAP_REPLACE_IF), repif.astype(jnp.int32),
-                           result)
-        result = jnp.where(op(OP_MAP_CONTAINS_KEY),
-                           hit_any.astype(jnp.int32), result)
-        result = jnp.where(op(OP_MAP_CONTAINS_VALUE),
-                           jnp.any(m_alive & (mv == a[..., None]),
-                                   axis=-1).astype(jnp.int32), result)
-        result = jnp.where(op(OP_MAP_SIZE), m_size, result)
-        result = jnp.where(op(OP_MAP_IS_EMPTY),
-                           (m_size == 0).astype(jnp.int32), result)
-    else:
-        result = jnp.where(is_map, INT_MIN, result)
+    rm = op(OP_MAP_REMOVE) | (op(OP_MAP_REMOVE_IF) & (old == b))
+    ml = _scatter3(ml, hit_idx, rm & hit_any, jnp.zeros_like(a, bool))
+    ml = jnp.where(op(OP_MAP_CLEAR)[..., None], False, ml)
+    # drop expired slots whenever any map op touches the group (lazy
+    # purge; just-written slots have dl == 0 or dl > now, so they
+    # always survive)
+    ml = jnp.where(is_map[..., None],
+                   ml & ((mdl == 0) | (mdl > now[..., None])), ml)
 
-    # ---- set --------------------------------------------------------------
+    m_size = jnp.sum(m_alive, axis=-1).astype(jnp.int32)
+    result = jnp.where(put, old, result)
+    result = jnp.where(put & write_new & ~free_any, INT_MIN, result)
+    result = jnp.where(pia, jnp.where(hit_any, 0,
+                       jnp.where(free_any, 1, INT_MIN)), result)
+    result = jnp.where(op(OP_MAP_GET), old, result)
+    result = jnp.where(op(OP_MAP_GET_OR_DEFAULT),
+                       jnp.where(hit_any, old, b), result)
+    result = jnp.where(op(OP_MAP_REMOVE), old, result)
+    result = jnp.where(op(OP_MAP_REMOVE_IF),
+                       (hit_any & (old == b)).astype(jnp.int32), result)
+    result = jnp.where(rep, jnp.where(hit_any, old, INT_MIN), result)
+    result = jnp.where(op(OP_MAP_REPLACE_IF), repif.astype(jnp.int32),
+                       result)
+    result = jnp.where(op(OP_MAP_CONTAINS_KEY),
+                       hit_any.astype(jnp.int32), result)
+    result = jnp.where(op(OP_MAP_CONTAINS_VALUE),
+                       jnp.any(m_alive & (mv == a[..., None]),
+                               axis=-1).astype(jnp.int32), result)
+    result = jnp.where(op(OP_MAP_SIZE), m_size, result)
+    result = jnp.where(op(OP_MAP_IS_EMPTY),
+                       (m_size == 0).astype(jnp.int32), result)
+    return (mk, mv, ml, mdl), result
+
+
+def apply_set(sk, sl, sdl, opcode, a, b, c, now, live):
+    """Probe-table set; returns ((sk, sl, sdl), result)."""
+    def op(code):
+        return live & (opcode == code)
+
     is_setop = live & (opcode >= OP_SET_ADD) & (opcode <= OP_SET_CLEAR)
-    if res.set_key.shape[-1] > 0:
-        sk, sl, sdl = res.set_key, res.set_live, res.set_dl
-        s_alive = sl & ((sdl == 0) | (sdl > now[..., None]))
-        s_hit = s_alive & (sk == a[..., None])
-        s_hit_idx, s_hit_any = _first_true(s_hit)
-        s_free_idx, s_free_any = _first_true(~s_alive)
+    result = jnp.zeros_like(opcode)
+    if sk.shape[-1] == 0:
+        return (sk, sl, sdl), jnp.where(is_setop, INT_MIN, result)
 
-        add = op(OP_SET_ADD) & ~s_hit_any & s_free_any
-        sk = _scatter3(sk, s_free_idx, add, a)
-        sdl = _scatter3(sdl, s_free_idx, add, jnp.where(c > 0, now + c, 0))
-        sl = _scatter3(sl, s_free_idx, add, jnp.ones_like(a, bool))
-        srm = op(OP_SET_REMOVE) & s_hit_any
-        sl = _scatter3(sl, s_hit_idx, srm, jnp.zeros_like(a, bool))
-        sl = jnp.where(op(OP_SET_CLEAR)[..., None], False, sl)
-        sl = jnp.where(is_setop[..., None],
-                       sl & ((sdl == 0) | (sdl > now[..., None])), sl)
-        updates.update(set_key=sk, set_live=sl, set_dl=sdl)
-        s_size = jnp.sum(s_alive, axis=-1).astype(jnp.int32)
-        result = jnp.where(op(OP_SET_ADD),
-                           jnp.where(s_hit_any, 0,
-                                     jnp.where(s_free_any, 1, INT_MIN)),
-                           result)
-        result = jnp.where(op(OP_SET_REMOVE), s_hit_any.astype(jnp.int32),
-                           result)
-        result = jnp.where(op(OP_SET_CONTAINS), s_hit_any.astype(jnp.int32),
-                           result)
-        result = jnp.where(op(OP_SET_SIZE), s_size, result)
-    else:
-        result = jnp.where(is_setop, INT_MIN, result)
+    s_alive = sl & ((sdl == 0) | (sdl > now[..., None]))
+    s_hit = s_alive & (sk == a[..., None])
+    s_hit_idx, s_hit_any = _first_true(s_hit)
+    s_free_idx, s_free_any = _first_true(~s_alive)
 
-    # ---- queue ------------------------------------------------------------
+    add = op(OP_SET_ADD) & ~s_hit_any & s_free_any
+    sk = _scatter3(sk, s_free_idx, add, a)
+    sdl = _scatter3(sdl, s_free_idx, add, jnp.where(c > 0, now + c, 0))
+    sl = _scatter3(sl, s_free_idx, add, jnp.ones_like(a, bool))
+    srm = op(OP_SET_REMOVE) & s_hit_any
+    sl = _scatter3(sl, s_hit_idx, srm, jnp.zeros_like(a, bool))
+    sl = jnp.where(op(OP_SET_CLEAR)[..., None], False, sl)
+    sl = jnp.where(is_setop[..., None],
+                   sl & ((sdl == 0) | (sdl > now[..., None])), sl)
+    s_size = jnp.sum(s_alive, axis=-1).astype(jnp.int32)
+    result = jnp.where(op(OP_SET_ADD),
+                       jnp.where(s_hit_any, 0,
+                                 jnp.where(s_free_any, 1, INT_MIN)),
+                       result)
+    result = jnp.where(op(OP_SET_REMOVE), s_hit_any.astype(jnp.int32),
+                       result)
+    result = jnp.where(op(OP_SET_CONTAINS), s_hit_any.astype(jnp.int32),
+                       result)
+    result = jnp.where(op(OP_SET_SIZE), s_size, result)
+    return (sk, sl, sdl), result
+
+
+def apply_queue(qv, qh, qs, opcode, a, b, c, now, live):
+    """FIFO ring queue; returns ((qv, qh, qs), result)."""
+    def op(code):
+        return live & (opcode == code)
+
     is_q = live & (opcode >= OP_Q_OFFER) & (opcode <= OP_Q_CLEAR)
-    if res.q_val.shape[-1] > 0:
-        qv, qh, qs = res.q_val, res.q_head, res.q_size
-        Q = qv.shape[-1]
-        offer = op(OP_Q_OFFER)
-        can_push = offer & (qs < Q)
-        qv = _scatter3(qv, (qh + qs) % Q, can_push, a)
-        head_val = _gather3(qv, qh % Q)
-        poll = op(OP_Q_POLL) & (qs > 0)
-        qs = jnp.where(can_push, qs + 1, qs)
-        qh = jnp.where(poll, qh + 1, qh)
-        qs = jnp.where(poll, qs - 1, qs)
-        qs = jnp.where(op(OP_Q_CLEAR), 0, qs)
-        updates.update(q_val=qv, q_head=qh, q_size=qs)
-        result = jnp.where(offer, can_push.astype(jnp.int32), result)
-        result = jnp.where(op(OP_Q_POLL),
-                           jnp.where(poll, head_val, INT_MIN), result)
-        result = jnp.where(op(OP_Q_PEEK),
-                           jnp.where(qs > 0, head_val, INT_MIN), result)
-        result = jnp.where(op(OP_Q_SIZE), qs, result)
-    else:
-        result = jnp.where(is_q, INT_MIN, result)
+    result = jnp.zeros_like(opcode)
+    if qv.shape[-1] == 0:
+        return (qv, qh, qs), jnp.where(is_q, INT_MIN, result)
 
-    # ---- lock -------------------------------------------------------------
-    holder = res.lk_holder
+    Q = qv.shape[-1]
+    offer = op(OP_Q_OFFER)
+    can_push = offer & (qs < Q)
+    qv = _scatter3(qv, (qh + qs) % Q, can_push, a)
+    head_val = _gather3(qv, qh % Q)
+    poll = op(OP_Q_POLL) & (qs > 0)
+    qs = jnp.where(can_push, qs + 1, qs)
+    qh = jnp.where(poll, qh + 1, qh)
+    qs = jnp.where(poll, qs - 1, qs)
+    qs = jnp.where(op(OP_Q_CLEAR), 0, qs)
+    result = jnp.where(offer, can_push.astype(jnp.int32), result)
+    result = jnp.where(op(OP_Q_POLL),
+                       jnp.where(poll, head_val, INT_MIN), result)
+    result = jnp.where(op(OP_Q_PEEK),
+                       jnp.where(qs > 0, head_val, INT_MIN), result)
+    result = jnp.where(op(OP_Q_SIZE), qs, result)
+    return (qv, qh, qs), result
+
+
+def apply_lock(holder, wid, wdl, wlv, lh, ls, opcode, a, b, now, live):
+    """Lock kernel; returns ((holder, wid, wdl, wlv, lh, ls), result,
+    (ev_mask, ev_code, ev_target, ev_arg))."""
+    def op(code):
+        return live & (opcode == code)
+
     is_lock = live & (opcode >= OP_LOCK_ACQUIRE) & (opcode <= OP_LOCK_HOLDER)
+    result = jnp.zeros_like(opcode)
+    ev_mask = jnp.zeros_like(live)
+    ev_code = jnp.zeros_like(opcode)
+    ev_target = jnp.zeros_like(opcode)
+    ev_arg = jnp.zeros_like(opcode)
+
     acq = op(OP_LOCK_ACQUIRE)
     rel = op(OP_LOCK_RELEASE)
     cxl = op(OP_LOCK_CANCEL)
@@ -502,11 +541,8 @@ def apply_entry(
     holder = jnp.where(grant_now, a, holder)
     idem = acq & held_by_me          # retried acquire we already won
     do_rel = rel & held_by_me
-    W = res.lk_wait_id.shape[-1]
+    W = wid.shape[-1]
     if W > 0:
-        wid, wdl, wlv = res.lk_wait_id, res.lk_wait_dl, res.lk_wait_live
-        lh, ls = res.lk_head, res.lk_size
-
         # Lazily expire timed-out waiters, then compact the ring: dead
         # slots (cancelled or expired anywhere in the window) must never
         # wedge capacity. Stable compaction keeps FIFO order.
@@ -545,8 +581,6 @@ def apply_entry(
         cxl_idx, cxl_found = _first_true(cxl_hit)
         wlv = _scatter3(wlv, cxl_idx, cxl & ~already & cxl_found,
                         jnp.zeros_like(a, bool))
-        updates.update(lk_wait_id=wid, lk_wait_dl=wdl, lk_wait_live=wlv,
-                       lk_head=lh, lk_size=ls)
 
         result = jnp.where(acq, jnp.where(
             grant_now | idem, 1,
@@ -564,13 +598,25 @@ def apply_entry(
         result = jnp.where(acq,
                            jnp.where(grant_now | idem, 1, 0), result)
         result = jnp.where(cxl, jnp.where(held_by_me, 2, 0), result)
-    updates["lk_holder"] = holder
     result = jnp.where(rel, do_rel.astype(jnp.int32), result)
     result = jnp.where(op(OP_LOCK_HOLDER), holder, result)
+    return (holder, wid, wdl, wlv, lh, ls), result, \
+        (ev_mask, ev_code, ev_target, ev_arg)
 
-    # ---- leader election --------------------------------------------------
-    el, ep = res.el_leader, res.el_epoch
+
+def apply_elect(el, ep, eid, elv, eh, es, opcode, a, b, index, live):
+    """Leader-election kernel; returns ((el, ep, eid, elv, eh, es),
+    result, (ev_mask, ev_code, ev_target, ev_arg))."""
+    def op(code):
+        return live & (opcode == code)
+
     is_el = live & (opcode >= OP_ELECT_LISTEN) & (opcode <= OP_ELECT_GET_EPOCH)
+    result = jnp.zeros_like(opcode)
+    ev_mask = jnp.zeros_like(live)
+    ev_code = jnp.zeros_like(opcode)
+    ev_target = jnp.zeros_like(opcode)
+    ev_arg = jnp.zeros_like(opcode)
+
     listen = op(OP_ELECT_LISTEN)
     resign = op(OP_ELECT_RESIGN)
     am_leader = el == a
@@ -579,10 +625,8 @@ def apply_entry(
     el = jnp.where(win_now, a, el)
     ep = jnp.where(win_now, index, ep)
     do_res = resign & am_leader
-    Wl = res.el_id.shape[-1]
+    Wl = eid.shape[-1]
     if Wl > 0:
-        eid, elv, eh, es = res.el_id, res.el_live, res.el_head, res.el_size
-
         # compact out unlisted waiters (same discipline as the lock ring)
         e_pos = _ring_pos(eh, Wl)
         e_in = e_pos < es[..., None]
@@ -615,7 +659,6 @@ def apply_entry(
         e_idx, e_found = _first_true(e_hit)
         elv = _scatter3(elv, e_idx, resign & ~do_res & e_found,
                         jnp.zeros_like(a, bool))
-        updates.update(el_id=eid, el_live=elv, el_head=eh, el_size=es)
 
         result = jnp.where(listen, jnp.where(win_now, index,
                            jnp.where(am_leader, ep,
@@ -628,29 +671,279 @@ def apply_entry(
         el = jnp.where(do_res, -1, el)
         result = jnp.where(listen, jnp.where(win_now, index,
                            jnp.where(am_leader, ep, INT_MIN)), result)
-    updates.update(el_leader=el, el_epoch=ep)
     result = jnp.where(resign, do_res.astype(jnp.int32), result)
     result = jnp.where(op(OP_ELECT_IS_LEADER),
                        (am_leader & (ep == b)).astype(jnp.int32), result)
     result = jnp.where(op(OP_ELECT_LEADER), el, result)
     result = jnp.where(op(OP_ELECT_GET_EPOCH), ep, result)
+    return (el, ep, eid, elv, eh, es), result, \
+        (ev_mask, ev_code, ev_target, ev_arg)
 
-    # ---- push the (single) session event into the outbox ring -------------
+
+def push_events(res: ResourceState, ev_mask, ev_code, ev_target, ev_arg,
+                ) -> ResourceState:
+    """Push one event per lane (where ``ev_mask``) into the outbox ring,
+    dropping the oldest on overflow."""
     E = res.ev_code.shape[-1]
-    if E > 0:
-        evc, evt, eva = res.ev_code, res.ev_target, res.ev_arg
-        evh, evtl = res.ev_head, res.ev_tail
-        overflow = ev_mask & ((evtl - evh) >= E)
-        evh = jnp.where(overflow, evh + 1, evh)  # drop oldest
-        slot = evtl % E
-        evc = _scatter3(evc, slot, ev_mask, ev_code)
-        evt = _scatter3(evt, slot, ev_mask, ev_target)
-        eva = _scatter3(eva, slot, ev_mask, ev_arg)
-        evtl = jnp.where(ev_mask, evtl + 1, evtl)
-        updates.update(ev_code=evc, ev_target=evt, ev_arg=eva,
-                       ev_head=evh, ev_tail=evtl)
+    if E == 0:
+        return res
+    evc, evt, eva = res.ev_code, res.ev_target, res.ev_arg
+    evh, evtl = res.ev_head, res.ev_tail
+    overflow = ev_mask & ((evtl - evh) >= E)
+    evh = jnp.where(overflow, evh + 1, evh)  # drop oldest
+    slot = evtl % E
+    evc = _scatter3(evc, slot, ev_mask, ev_code)
+    evt = _scatter3(evt, slot, ev_mask, ev_target)
+    eva = _scatter3(eva, slot, ev_mask, ev_arg)
+    evtl = jnp.where(ev_mask, evtl + 1, evtl)
+    return res._replace(ev_code=evc, ev_target=evt, ev_arg=eva,
+                        ev_head=evh, ev_tail=evtl)
 
-    return res._replace(**updates), result
+
+# ---------------------------------------------------------------------------
+# the apply kernel
+# ---------------------------------------------------------------------------
+
+def apply_entry(
+    res: ResourceState,
+    opcode: jnp.ndarray,  # [G,P] i32
+    a: jnp.ndarray,       # [G,P] i32
+    b: jnp.ndarray,       # [G,P] i32
+    c: jnp.ndarray,       # [G,P] i32
+    index: jnp.ndarray,   # [G,P] i32 — absolute log index of this entry
+    now: jnp.ndarray,     # [G,P] i32 — entry's logical timestamp
+    live: jnp.ndarray,    # [G,P] bool — entry exists and is being applied
+) -> tuple[ResourceState, jnp.ndarray]:
+    """Apply one committed entry per (group, replica) lane.
+
+    Composition of the six per-pool kernels (an entry belongs to exactly
+    one pool, so the untouched pools pass through unchanged — XLA elides
+    them). The step's hot path instead folds each pool separately
+    (:func:`apply_window`); this composed form serves the query lane,
+    single-entry callers and the differential tests.
+
+    Returns ``(new_state, result)`` where ``result`` is the int32 command
+    response for the lane (meaningful only where ``live``). Session events
+    are pushed into the state's event ring.
+    """
+    (value, val_dl), r_val = apply_value(
+        res.value, res.val_dl, opcode, a, b, c, now, live)
+    (mk, mv, ml, mdl), r_map = apply_map(
+        res.map_key, res.map_val, res.map_live, res.map_dl,
+        opcode, a, b, c, now, live)
+    (sk, sl, sdl), r_set = apply_set(
+        res.set_key, res.set_live, res.set_dl, opcode, a, b, c, now, live)
+    (qv, qh, qs), r_q = apply_queue(
+        res.q_val, res.q_head, res.q_size, opcode, a, b, c, now, live)
+    (holder, wid, wdl, wlv, lh, ls), r_lock, ev_lock = apply_lock(
+        res.lk_holder, res.lk_wait_id, res.lk_wait_dl, res.lk_wait_live,
+        res.lk_head, res.lk_size, opcode, a, b, now, live)
+    (el, ep, eid, elv, eh, es), r_el, ev_el = apply_elect(
+        res.el_leader, res.el_epoch, res.el_id, res.el_live,
+        res.el_head, res.el_size, opcode, a, b, index, live)
+
+    # exactly one pool claims each opcode, so results merge by sum of the
+    # disjoint contributions
+    result = r_val + r_map + r_set + r_q + r_lock + r_el
+
+    res = res._replace(
+        value=value, val_dl=val_dl,
+        map_key=mk, map_val=mv, map_live=ml, map_dl=mdl,
+        set_key=sk, set_live=sl, set_dl=sdl,
+        q_val=qv, q_head=qh, q_size=qs,
+        lk_holder=holder, lk_wait_id=wid, lk_wait_dl=wdl, lk_wait_live=wlv,
+        lk_head=lh, lk_size=ls,
+        el_leader=el, el_epoch=ep, el_id=eid, el_live=elv, el_head=eh,
+        el_size=es)
+
+    # grant/elect are mutually exclusive across opcodes: one event max
+    ev_mask = ev_lock[0] | ev_el[0]
+    pick = lambda i: jnp.where(ev_lock[0], ev_lock[i], ev_el[i])
+    return push_events(res, ev_mask, pick(1), pick(2), pick(3)), result
+
+
+def push_events_window(res: ResourceState, mask: jnp.ndarray,
+                       code: jnp.ndarray, target: jnp.ndarray,
+                       arg: jnp.ndarray) -> ResourceState:
+    """Push a window of per-lane event candidates (``[G,P,A]``, ≤1 event
+    per window position, ordered by position = log order) into the outbox
+    ring in ONE fused pass per ring array, dropping the oldest entries on
+    overflow — bit-identical ring evolution to pushing the events one
+    entry at a time in log order."""
+    E = res.ev_code.shape[-1]
+    if E == 0 or mask.shape[-1] == 0:
+        return res
+    evh, evtl = res.ev_head, res.ev_tail
+    count = mask.sum(axis=-1, dtype=jnp.int32)             # [G,P]
+    off = jnp.cumsum(mask, axis=-1, dtype=jnp.int32) - mask  # exclusive
+    # If the window somehow carries more events than the ring holds, only
+    # the LAST E survive (same drop-oldest outcome as sequential pushes)
+    # — also guarantees distinct slots below, so the one-hot sum is exact.
+    mask = mask & (off >= count[..., None] - E)
+    slot = (evtl[..., None] + off) % E                     # [G,P,A]
+    hit = (slot[..., None] == jnp.arange(E, dtype=jnp.int32)) \
+        & mask[..., None]                                  # [G,P,A,E]
+    any_hit = hit.any(axis=2)                              # [G,P,E]
+
+    def write(ring, vals):
+        filled = jnp.where(hit, vals[..., None], 0).sum(axis=2)
+        return jnp.where(any_hit, filled.astype(ring.dtype), ring)
+
+    new_tail = evtl + count
+    new_head = jnp.maximum(evh, new_tail - E)              # drop-oldest
+    return res._replace(
+        ev_code=write(res.ev_code, code),
+        ev_target=write(res.ev_target, target),
+        ev_arg=write(res.ev_arg, arg),
+        ev_head=new_head, ev_tail=new_tail)
+
+
+def apply_window(
+    res: ResourceState,
+    opcode: jnp.ndarray,  # [G,P,A] window-position-major entry fields
+    a: jnp.ndarray,
+    b: jnp.ndarray,
+    c: jnp.ndarray,
+    index: jnp.ndarray,   # [G,P,A] absolute log indexes (contiguous)
+    now: jnp.ndarray,     # [G,P,A] entry timestamps
+    do: jnp.ndarray,      # [G,P,A] bool — within this round's commit budget
+    budgets: tuple,       # per-pool applies admitted per round (len 6, ≥1)
+) -> tuple[ResourceState, jnp.ndarray, jnp.ndarray]:
+    """Conflict-partitioned apply of a contiguous window of ≤A entries.
+
+    The legacy formulation scanned ``apply_entry`` A times, dragging EVERY
+    pool's state through HBM per iteration — ~95% of the mixed-scenario
+    round (PERF.md "Known next bottleneck"). Entries in different pools
+    commute (disjoint state), so here each pool folds only ITS entries —
+    compacted to ``budgets[k]`` scan iterations over only that pool's
+    arrays. Log order is preserved within each pool (the only order that
+    matters); the admitted window is the longest prefix in which no pool
+    exceeds its budget, so a lane never applies entry j before j-1.
+
+    Returns ``(new_res, result [G,P,A], admitted [G,P,A])`` — results are
+    positioned at their window slots; non-admitted entries stay pending
+    for the next round (exactly like the existing per-round A budget).
+
+    Events are scattered back to their window positions and pushed in log
+    order (``push_events_window``), so the outbox ring evolves
+    bit-identically to the sequential formulation.
+    """
+    A = opcode.shape[-1]
+    pool = pool_of(jnp.where(do, opcode, -1))  # !do → POOL_NONE (opcode -1)
+    is_pool = [(pool == k) for k in range(NUM_POOLS)]
+
+    # Longest prefix in which every pool stays within budget.
+    admitted = do
+    rank = []
+    for k in range(NUM_POOLS):
+        cum = jnp.cumsum(is_pool[k].astype(jnp.int32), axis=-1)
+        rank.append(jnp.where(is_pool[k], cum - 1, A))
+        if budgets[k] < A:
+            admitted = admitted & jnp.where(is_pool[k],
+                                            cum <= budgets[k], True)
+    admitted = jnp.cumprod(admitted.astype(jnp.int32), axis=-1).astype(bool)
+
+    result = jnp.zeros_like(opcode)
+
+    def fold(kernel, state_arrays, k, n_out):
+        """Scan ``kernel`` over pool k's ≤budgets[k] compacted entries,
+        carrying only ``state_arrays``. Returns (state, result
+        contribution [G,P,A], events scattered to window positions —
+        (mask, code, target, arg) each [G,P,A], or None).
+
+        When the budget covers the whole window (B >= A), compaction
+        would be the identity up to padding — skip it and iterate the
+        window positions directly (zero overhead vs the legacy scan)."""
+        B = min(budgets[k], A)
+        sel = admitted & is_pool[k]
+        if B >= A:
+            oh = None
+            live_b = sel
+            fields = (opcode, a, b, c, index, now)
+        else:
+            oh = (rank[k][..., None] == jnp.arange(B, dtype=jnp.int32)) \
+                & sel[..., None]                              # [G,P,A,B]
+            pick = lambda arr: jnp.where(oh, arr[..., None], 0).sum(axis=2)
+            live_b = jnp.any(oh, axis=2)                      # [G,P,B]
+            fields = tuple(pick(f) for f in (opcode, a, b, c, index, now))
+        xs = jax.tree.map(lambda x: jnp.moveaxis(x, -1, 0),   # [B,G,P]
+                          fields + (live_b,))
+
+        def body(st, x):
+            op_i, a_i, b_i, c_i, idx_i, now_i, live_i = x
+            out = kernel(*st, op_i, a_i, b_i, c_i, idx_i, now_i, live_i)
+            return out[0], out[1:]
+        # Full unroll: lax.scan blocks cross-iteration fusion, and with
+        # only ONE pool's arrays in the carry, XLA fuses the unrolled
+        # iterations into far fewer passes over that pool's HBM.
+        state, outs = jax.lax.scan(body, state_arrays, xs, unroll=True)
+
+        def unpick(stacked):  # [B,G,P] -> [G,P,A] at window positions
+            by_slot = jnp.moveaxis(stacked, 0, -1)            # [G,P,B]
+            if oh is None:
+                return by_slot
+            return jnp.where(oh, by_slot[..., None, :], 0).sum(axis=-1)
+
+        contribution = unpick(outs[0])
+        events = None
+        if n_out > 1:
+            events = tuple(unpick(x) for x in outs[1])
+        return state, contribution, events
+
+    # adapters: uniform (state..., op, a, b, c, index, now, live) signature
+    k_val = lambda v, dl, op_, a_, b_, c_, i_, n_, lv: \
+        apply_value(v, dl, op_, a_, b_, c_, n_, lv)
+    k_map = lambda mk, mv, ml, mdl, op_, a_, b_, c_, i_, n_, lv: \
+        apply_map(mk, mv, ml, mdl, op_, a_, b_, c_, n_, lv)
+    k_set = lambda sk, sl, sdl, op_, a_, b_, c_, i_, n_, lv: \
+        apply_set(sk, sl, sdl, op_, a_, b_, c_, n_, lv)
+    k_q = lambda qv, qh, qs, op_, a_, b_, c_, i_, n_, lv: \
+        apply_queue(qv, qh, qs, op_, a_, b_, c_, n_, lv)
+    k_lock = lambda h, wi, wd, wl, lh, ls, op_, a_, b_, c_, i_, n_, lv: \
+        apply_lock(h, wi, wd, wl, lh, ls, op_, a_, b_, n_, lv)
+    k_el = lambda el, ep, ei, el_, eh, es, op_, a_, b_, c_, i_, n_, lv: \
+        apply_elect(el, ep, ei, el_, eh, es, op_, a_, b_, i_, lv)
+
+    (value, val_dl), r, _ = fold(
+        k_val, (res.value, res.val_dl), POOL_VALUE, 1)
+    result = result + r
+    (mk, mv, ml, mdl), r, _ = fold(
+        k_map, (res.map_key, res.map_val, res.map_live, res.map_dl),
+        POOL_MAP, 1)
+    result = result + r
+    (sk, sl, sdl), r, _ = fold(
+        k_set, (res.set_key, res.set_live, res.set_dl), POOL_SET, 1)
+    result = result + r
+    (qv, qh, qs), r, _ = fold(
+        k_q, (res.q_val, res.q_head, res.q_size), POOL_QUEUE, 1)
+    result = result + r
+    (holder, wid, wdl, wlv, lh, ls), r, ev_lock = fold(
+        k_lock, (res.lk_holder, res.lk_wait_id, res.lk_wait_dl,
+                 res.lk_wait_live, res.lk_head, res.lk_size),
+        POOL_LOCK, 2)
+    result = result + r
+    (el, ep, eid, elv, eh, es), r, ev_el = fold(
+        k_el, (res.el_leader, res.el_epoch, res.el_id, res.el_live,
+               res.el_head, res.el_size), POOL_ELECT, 2)
+    result = result + r
+
+    res = res._replace(
+        value=value, val_dl=val_dl,
+        map_key=mk, map_val=mv, map_live=ml, map_dl=mdl,
+        set_key=sk, set_live=sl, set_dl=sdl,
+        q_val=qv, q_head=qh, q_size=qs,
+        lk_holder=holder, lk_wait_id=wid, lk_wait_dl=wdl, lk_wait_live=wlv,
+        lk_head=lh, lk_size=ls,
+        el_leader=el, el_epoch=ep, el_id=eid, el_live=elv, el_head=eh,
+        el_size=es)
+    # Merge the two event-producing pools by window position (disjoint —
+    # an entry belongs to one pool) and push in log order.
+    ev_mask = ev_lock[0].astype(bool) | ev_el[0].astype(bool)
+    res = push_events_window(res, ev_mask,
+                             ev_lock[1] + ev_el[1],
+                             ev_lock[2] + ev_el[2],
+                             ev_lock[3] + ev_el[3])
+    return res, result, admitted
 
 
 def drain_events(res: ResourceState, n: int, mask: jnp.ndarray

@@ -43,10 +43,12 @@ import jax
 import jax.numpy as jnp
 
 from .apply import (
+    NUM_POOLS,
     ResourceConfig,
     ResourceState,
     _gather3,
     apply_entry,
+    apply_window,
     drain_events,
     init_resources,
 )
@@ -124,9 +126,16 @@ class Config(NamedTuple):
 
     append_window: int = 4    # entries per AppendEntries per round
     applies_per_round: int = 4
-    apply_unroll: int = 1     # lax.scan unroll of the apply loop: >1 lets
-    #                           XLA fuse consecutive applies into fewer
-    #                           full-pool HBM passes (see PERF.md)
+    # Per-pool apply budgets (value, map, set, queue, lock, election):
+    # the apply phase folds each pool's entries separately, carrying only
+    # that pool's arrays — entries in different pools commute — and admits
+    # the longest window prefix in which no pool exceeds its budget
+    # (apply.py apply_window; PERF.md "conflict-partitioned apply").
+    # None = every pool gets the full applies_per_round budget. For mixed
+    # workloads where each round touches each pool once or twice, small
+    # budgets for the big pools (map/set/queue/lock/election) cut the
+    # apply phase's HBM traffic by ~budget/A.
+    pool_budgets: tuple | None = None
     timer_min: int = 4        # election timeout in rounds (randomized range)
     timer_max: int = 9
     events_per_round: int = 4  # outbox events drained per step
@@ -559,51 +568,64 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
     last_f = jnp.where(won, noop_idx, last2)
 
     # ---- phase 5: apply committed entries (all replicas, A per round) ----
-    # Reporting lane: the lane with the highest applied_index AFTER this
-    # round's budget (post = min(applied + A, commit)). In the first round
-    # the global max passes an entry, the argmax lane applies it (all lanes
-    # started below it), so every result is reported at least once — even
-    # when the group is leaderless (see StepOutputs docstring).
-    post_applied = jnp.minimum(state.applied_index + A, commit2)
-    rep = jnp.argmax(post_applied, axis=1).astype(jnp.int32)  # [G]
-
-    # The lane applies indices applied+1 .. post_applied — contiguous, so
-    # all A candidate entries are gathered in ONE fused one-hot
-    # select-reduce per log array here (take_along_axis lowers to an
-    # element-wise DMA loop on TPU; the masked sum is a vector pass).
-    # Iteration j's entry is applied+1+j with do_j = in-commit-budget;
-    # stalled iterations were no-ops in the sequential formulation too.
+    # All A candidate entries (contiguous indices applied+1 .. applied+A,
+    # capped at commit) are gathered in ONE fused one-hot select-reduce
+    # per log array (take_along_axis lowers to an element-wise DMA loop on
+    # TPU; the masked sum is a vector pass), then applied by the
+    # conflict-partitioned window kernel: each resource pool folds only
+    # ITS entries, carrying only its own arrays (apply.py apply_window).
     idx_all = state.applied_index[..., None] + 1 \
         + jnp.arange(A, dtype=jnp.int32)[None, None, :]       # [G,P,A]
     slot_all = (idx_all - 1) % L
     do_all = idx_all <= commit2[..., None]
     win_oh = slot_all[..., None] == jnp.arange(L, dtype=jnp.int32)  # [G,P,A,L]
     ga = lambda log: jnp.where(win_oh, log[:, :, None, :], 0).sum(axis=-1)
-    xs = jax.tree.map(
-        lambda x: jnp.moveaxis(x, 2, 0),                      # [A,G,P]
-        (ga(log_op2), ga(log_a2), ga(log_b2), ga(log_c2),
-         ga(log_time2), idx_all, do_all))
+    time_w = ga(log_time2)
+    if config.pool_budgets is not None:
+        if len(config.pool_budgets) != NUM_POOLS:
+            raise ValueError(
+                f"pool_budgets needs {NUM_POOLS} entries "
+                f"(value,map,set,queue,lock,election), got "
+                f"{config.pool_budgets!r}")
+        budgets = tuple(max(1, min(int(x), A))
+                        for x in config.pool_budgets)
+        resources, res_w, admitted = apply_window(
+            state.resources, ga(log_op2), ga(log_a2), ga(log_b2),
+            ga(log_c2), idx_all, time_w, do_all, budgets)
+    else:
+        # No budgets → every entry in the window applies; the single
+        # sequential scan over the composed kernel has fewer fusions than
+        # six per-pool folds, which wins when the step is dispatch-bound
+        # (small G / single-pool workloads). The partitioned path wins
+        # when budgets shrink a heavy pool's HBM traffic (mixed configs).
+        xs = jax.tree.map(
+            lambda x: jnp.moveaxis(x, 2, 0),                  # [A,G,P]
+            (ga(log_op2), ga(log_a2), ga(log_b2), ga(log_c2),
+             time_w, idx_all, do_all))
 
-    # lax.scan keeps the compiled program one apply-kernel big, not A× big.
-    # The body is pure elementwise apply — all lane views happen after.
-    def _apply_one(resources, x):
-        op_i, a_i, b_i, c_i, time_i, idx, do = x
-        resources, result = apply_entry(
-            resources, op_i, a_i, b_i, c_i, idx, time_i, do)
-        return resources, result
+        def _apply_one(resources, x):
+            op_i, a_i, b_i, c_i, time_i, idx, do = x
+            return apply_entry(resources, op_i, a_i, b_i, c_i, idx,
+                               time_i, do)
 
-    resources, res_all = jax.lax.scan(_apply_one, state.resources, xs,
-                                  unroll=config.apply_unroll)
-    applied = post_applied
+        resources, res_all = jax.lax.scan(_apply_one, state.resources, xs)
+        res_w = jnp.moveaxis(res_all, 0, 2)                   # [G,P,A]
+        admitted = do_all
+    applied = state.applied_index \
+        + admitted.sum(axis=-1, dtype=jnp.int32)
 
-    # Reporting-lane views, one fused pass each over [G,P,A].
+    # Reporting lane: the lane with the highest applied_index AFTER this
+    # round. In the first round the global max passes an entry, the argmax
+    # lane applies it (all lanes started below it), so every result is
+    # reported at least once — even when the group is leaderless (see
+    # StepOutputs docstring). One fused pass each over [G,P,A].
+    rep = jnp.argmax(applied, axis=1).astype(jnp.int32)       # [G]
     rep_oh = peer_ids[None, :] == rep[:, None]                # [G,P]
     rep3 = lambda x: jnp.where(rep_oh[:, :, None], x, 0).sum(axis=1)
-    out_valid = rep3(do_all).astype(bool)                     # [G,A]
+    out_valid = rep3(admitted).astype(bool)                   # [G,A]
     out_tag = jnp.where(out_valid, rep3(ga(log_tag2)), 0)
-    out_result = jnp.where(
-        out_valid, rep3(jnp.moveaxis(res_all, 0, 2)), 0)      # [A,G,P]→[G,P,A]
-    time_rep = rep3(jnp.moveaxis(xs[4], 0, 2))  # gathered log_time, reused
+    out_result = jnp.where(out_valid, rep3(res_w), 0)
+    time_rep = rep3(time_w)
     out_latency = jnp.where(out_valid, l_clock[:, None] - time_rep, 0)
 
     # ---- phase 6: drain session events (leader lane → host) --------------
