@@ -1,0 +1,203 @@
+"""Linearizability verdict at bench scale (BASELINE.md's "Jepsen pass").
+
+The reference's claim to fame is external Jepsen verification
+(``/root/reference/README.md:8``); the in-tree Wing & Gong checker
+(:mod:`linearize`) covers it on small histories in tests. This runner
+produces the VERDICT ARTIFACT at bench scale: a ``RaftGroups`` batch of
+≥10k groups runs under a randomized nemesis (partitions, isolation,
+message loss) with client load, histories are recorded on a sample of
+groups across three resource models (register/counter, map, try-lock),
+and every sampled history is checked. Output: one JSON line on stdout +
+``LINEARIZABILITY.md`` rewritten with the verdict.
+
+Run: ``python -m copycat_tpu.testing.verdict`` (env overrides:
+``COPYCAT_VERDICT_GROUPS/SAMPLE/ROUNDS/SEED``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from ..models.raft_groups import RaftGroups
+from ..ops import apply as ap
+from .history import HistoryRecorder
+from .linearize import LockModel, MapModel, RegisterModel, check_linearizable
+from .nemesis import Nemesis
+
+GROUPS = int(os.environ.get("COPYCAT_VERDICT_GROUPS", "10000"))
+SAMPLE = int(os.environ.get("COPYCAT_VERDICT_SAMPLE", "99"))
+ROUNDS = int(os.environ.get("COPYCAT_VERDICT_ROUNDS", "400"))
+SEED = int(os.environ.get("COPYCAT_VERDICT_SEED", "42"))
+BACKGROUND_PER_ROUND = 500  # untracked load spread over the other groups
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _invoke_register(rec: HistoryRecorder, g: int, rng) -> None:
+    kind = int(rng.integers(4))
+    if kind == 0:
+        v = int(rng.integers(1, 50))
+        rec.invoke(g, ap.OP_VALUE_SET, ("set", v), a=v)
+    elif kind == 1:
+        rec.invoke(g, ap.OP_VALUE_GET, ("get",))
+    elif kind == 2:
+        e, u = int(rng.integers(0, 50)), int(rng.integers(1, 50))
+        rec.invoke(g, ap.OP_VALUE_CAS, ("cas", e, u), a=e, b=u)
+    else:
+        d = int(rng.integers(1, 5))
+        rec.invoke(g, ap.OP_LONG_ADD, ("add", d), a=d)
+
+
+def _invoke_map(rec: HistoryRecorder, g: int, rng) -> None:
+    kind = int(rng.integers(4))
+    k = int(rng.integers(0, 8))
+    if kind == 0:
+        v = int(rng.integers(1, 99))
+        rec.invoke(g, ap.OP_MAP_PUT, ("put", k, v), a=k, b=v)
+    elif kind == 1:
+        rec.invoke(g, ap.OP_MAP_GET, ("get", k), a=k)
+    elif kind == 2:
+        rec.invoke(g, ap.OP_MAP_REMOVE, ("remove", k), a=k)
+    else:
+        rec.invoke(g, ap.OP_MAP_CONTAINS_KEY, ("contains", k), a=k)
+
+
+def _invoke_lock(rec: HistoryRecorder, g: int, rng) -> None:
+    who = int(rng.integers(1, 4))
+    if rng.random() < 0.5:
+        rec.invoke(g, ap.OP_LOCK_ACQUIRE, ("acquire", who), a=who, b=0)
+    else:
+        rec.invoke(g, ap.OP_LOCK_RELEASE, ("release", who), a=who)
+
+
+def run_verdict() -> dict:
+    t0 = time.time()
+    rg = RaftGroups(GROUPS, 3, log_slots=64, submit_slots=4, seed=SEED)
+    rg.wait_for_leaders()
+    rec = HistoryRecorder(rg)
+    nemesis = Nemesis(rg, seed=SEED + 1, period=12)
+    rng = np.random.default_rng(SEED + 2)
+
+    # sample split across the three checked models
+    sampled = rng.choice(GROUPS, size=SAMPLE, replace=False)
+    third = SAMPLE // 3
+    reg_groups = [int(g) for g in sampled[:third]]
+    map_groups = [int(g) for g in sampled[third:2 * third]]
+    lock_groups = [int(g) for g in sampled[2 * third:]]
+    others = np.setdiff1d(np.arange(GROUPS), sampled)
+
+    _log(f"verdict: G={GROUPS} sample={SAMPLE} rounds={ROUNDS} "
+         f"nemesis period=12 device load={BACKGROUND_PER_ROUND}/round")
+    bg_tags: set[int] = set()
+    for round_no in range(ROUNDS):
+        nemesis.tick()
+        # recorded client ops: one per sampled group every 4 rounds
+        if round_no % 4 == 0:
+            for g in reg_groups:
+                _invoke_register(rec, g, rng)
+            for g in map_groups:
+                _invoke_map(rec, g, rng)
+            for g in lock_groups:
+                _invoke_lock(rec, g, rng)
+        # background load on the rest of the batch (untracked counters —
+        # their resolved results are reaped so rg.results stays bounded)
+        n_bg = min(BACKGROUND_PER_ROUND, len(others))
+        for g in rng.choice(others, size=n_bg, replace=False):
+            bg_tags.add(rg.submit(int(g), ap.OP_LONG_ADD, 1))
+        rec.tick()
+        bg_tags = {t for t in bg_tags if rg.results.pop(t, None) is None}
+        if round_no % 50 == 49:
+            _log(f"verdict: round {round_no + 1}/{ROUNDS} "
+                 f"fault={nemesis.current} pending={len(rec._pending)}")
+    nemesis.heal()
+    for _ in range(300):
+        if not rec._pending:
+            break
+        rec.tick()
+
+    checked = failures = total_ops = total_nodes = 0
+    for groups, model in ((reg_groups, RegisterModel),
+                          (map_groups, MapModel),
+                          (lock_groups, LockModel)):
+        for g in groups:
+            hist = rec.history(g)
+            total_ops += len(hist)
+            res = check_linearizable(hist, model)
+            checked += 1
+            total_nodes += res.nodes
+            if not res.ok:
+                failures += 1
+                _log(f"verdict: VIOLATION group {g} "
+                     f"({model.__name__}): {hist}")
+
+    result = {
+        "linearizable": failures == 0,
+        "groups": GROUPS,
+        "sampled_groups": checked,
+        "checked_ops": total_ops,
+        "rounds": ROUNDS,
+        "nemesis": "partition/isolate/loss, period 12",
+        "violations": failures,
+        "search_nodes": total_nodes,
+        "incomplete_ops": len(rec._pending),
+        "wall_s": round(time.time() - t0, 1),
+        "seed": SEED,
+    }
+    return result
+
+
+def _write_artifact(result: dict) -> None:
+    lines = [
+        "# LINEARIZABILITY — verdict artifact at bench scale",
+        "",
+        "BASELINE.md's metric line ends \"Jepsen pass\" (the reference's"
+        " claim rests on",
+        "external Jepsen runs, `README.md:8`). This artifact is the"
+        " in-tree equivalent,",
+        "produced by `python -m copycat_tpu.testing.verdict`: a"
+        f" {result['groups']:,}-group device",
+        "batch ran under a randomized nemesis (partitions, single-peer"
+        " isolation,",
+        "30% message loss; period 12 rounds) with client load;"
+        f" {result['sampled_groups']}",
+        "sampled groups recorded real-time histories across three"
+        " resource models",
+        "(linearizable register/counter, map, try-lock), each checked"
+        " with the",
+        "Wing & Gong checker (`copycat_tpu/testing/linearize.py`).",
+        "",
+        "```json",
+        json.dumps(result, indent=2),
+        "```",
+        "",
+        "Semantics of the verdict: every completed operation's result is",
+        "explainable by a total order consistent with real-time"
+        " (invoke/complete",
+        "windows in driver rounds); operations that never completed"
+        " (e.g. submitted",
+        "into a partitioned leader) may linearize at any point or"
+        " never — exactly a",
+        "Jepsen client's crashed-request semantics.",
+        "",
+    ]
+    with open("LINEARIZABILITY.md", "w") as f:
+        f.write("\n".join(lines))
+
+
+def main() -> None:
+    result = run_verdict()
+    _write_artifact(result)
+    print(json.dumps(result))
+    if not result["linearizable"]:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()
