@@ -57,10 +57,14 @@ RESOURCE_CONFIGS = {
     "counter": ResourceConfig.counters_only(),
     "election": ResourceConfig.counters_only(),
     "map": ResourceConfig(set_slots=0, queue_slots=0, wait_slots=0,
-                          listener_slots=0, event_slots=0),
+                          listener_slots=0, event_slots=0,
+                          multimap_slots=0, topic_slots=0),
     "lock": ResourceConfig(map_slots=0, set_slots=0, queue_slots=0,
-                           listener_slots=0),
-    "mixed": ResourceConfig(),  # every pool live: full-system config #5
+                           listener_slots=0, multimap_slots=0,
+                           topic_slots=0),
+    # config #5 keeps its round-2 definition (the six original kernels)
+    # so numbers stay comparable; multimap/topic have their own coverage
+    "mixed": ResourceConfig(multimap_slots=0, topic_slots=0),
 }
 
 SCENARIO = os.environ.get("COPYCAT_BENCH_SCENARIO", "counter")
@@ -92,8 +96,8 @@ USE_PALLAS = os.environ.get(
 # - counter/election/map: sequential scan measures equal or better
 #   (dispatch-bound or single-pool-dominant with value planes tiny).
 _full = str(max(4, SUBMIT_SLOTS))  # = applies_per_round, never a throttle
-_default_budgets = {"mixed": "4,6,4,6,4,4",
-                    "lock": ",".join([_full] * 6)}.get(SCENARIO, "")
+_default_budgets = {"mixed": "4,6,4,6,4,4,4,4",
+                    "lock": ",".join([_full] * 8)}.get(SCENARIO, "")
 _budgets_env = os.environ.get("COPYCAT_BENCH_POOL_BUDGETS", _default_budgets)
 POOL_BUDGETS = (tuple(int(x) for x in _budgets_env.split(","))
                 if _budgets_env else None)

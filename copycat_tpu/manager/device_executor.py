@@ -656,6 +656,130 @@ class DeviceSetState(DeviceBackedStateMachine):
         super().delete()
 
 
+class DeviceMultiMapState(DeviceBackedStateMachine):
+    """Multimap: int32 (key, value) pairs live in the device pair-probe
+    table (``ops/apply.py`` OP_MM_*), overflow and non-int32 payloads
+    shadow host-side; the host retains commits per pair (the reference's
+    nested ``Map<Object, Map<Object, Commit>>`` discipline,
+    ``MultiMapState.java:30``)."""
+
+    def __init__(self, engine: DeviceEngine, group: int) -> None:
+        super().__init__(engine, group)
+        # (key, value) -> _Held; on_device=True ⇒ pair lives on device
+        self._held: dict[tuple, _Held] = {}
+
+    def _evict(self, pair: tuple, held: _Held) -> None:
+        del self._held[pair]
+        if held.on_device:
+            self._cmd(ops().OP_MM_REMOVE_ENTRY, pair[0], pair[1])
+        held.discard()
+
+    def put(self, commit: Commit[cc.MultiMapPut]) -> bool:
+        op = commit.operation
+        pair = (op.key, op.value)
+        if pair in self._held:
+            commit.clean()
+            return False
+        if (_devint(op.key) and _devint(op.value)
+                and self._cmd(ops().OP_MM_PUT, op.key,
+                              op.value) not in (FAIL(), 0)):
+            held = _Held(commit, on_device=True)
+        else:
+            held = _Held(commit)
+        self._held[pair] = held
+        if op.ttl:
+            def expire() -> None:
+                if self._held.get(pair) is held:
+                    self._evict(pair, held)
+
+            held.timer = self.executor.schedule(op.ttl, expire)
+        return True
+
+    def get(self, commit: Commit[cc.MultiMapGet]) -> list:
+        try:
+            key = commit.operation.key
+            return [v for (k, v) in self._held if k == key]
+        finally:
+            commit.close()
+
+    def remove(self, commit: Commit[cc.MultiMapRemove]) -> list:
+        key = commit.operation.key
+        commit.clean()
+        pairs = [p for p in self._held if p[0] == key]
+        if any(self._held[p].on_device for p in pairs):
+            self._cmd(ops().OP_MM_REMOVE, key)  # drops every device pair
+        out = []
+        for pair in pairs:
+            held = self._held.pop(pair)
+            out.append(pair[1])
+            held.discard()
+        return out
+
+    def remove_entry(self, commit: Commit[cc.MultiMapRemoveEntry]) -> bool:
+        op = commit.operation
+        commit.clean()
+        held = self._held.get((op.key, op.value))
+        if held is None:
+            return False
+        self._evict((op.key, op.value), held)
+        return True
+
+    def contains_key(self, commit: Commit[cc.MultiMapContainsKey]) -> bool:
+        try:
+            key = commit.operation.key
+            return any(k == key for (k, _v) in self._held)
+        finally:
+            commit.close()
+
+    def contains_entry(self, commit: Commit[cc.MultiMapContainsEntry]) -> bool:
+        # The host dict key IS the (key, value) pair, kept in lockstep
+        # with the device table (TTLs run host-side), so it is
+        # authoritative — no device round-trip needed.
+        try:
+            return (commit.operation.key,
+                    commit.operation.value) in self._held
+        finally:
+            commit.close()
+
+    def contains_value(self, commit: Commit[cc.MultiMapContainsValue]) -> bool:
+        try:
+            value = commit.operation.value
+            return any(v == value for (_k, v) in self._held)
+        finally:
+            commit.close()
+
+    def is_empty(self, commit: Commit[cc.MultiMapIsEmpty]) -> bool:
+        try:
+            return not self._held
+        finally:
+            commit.close()
+
+    def size(self, commit: Commit[cc.MultiMapSize]) -> int:
+        try:
+            key = commit.operation.key
+            if key is not None:
+                return sum(1 for (k, _v) in self._held if k == key)
+            return len(self._held)
+        finally:
+            commit.close()
+
+    def clear(self, commit: Commit[cc.MultiMapClear]) -> None:
+        if any(h.on_device for h in self._held.values()):
+            self._cmd(ops().OP_MM_CLEAR)
+        for held in self._held.values():
+            held.discard()
+        self._held.clear()
+        commit.clean()
+
+    def delete(self) -> None:
+        if any(h.on_device for h in self._held.values()):
+            self._cmd(ops().OP_MM_CLEAR)  # reset for group reuse
+        for held in self._held.values():
+            held.discard()
+        self._held.clear()
+        super().delete()
+
+
 # ---------------------------------------------------------------------------
 # queue
 # ---------------------------------------------------------------------------
@@ -1101,14 +1225,19 @@ def FAIL() -> int:
 
 def device_machine_for(machine_cls: type) -> type | None:
     """Device-backed equivalent for a CPU state machine class, or ``None``
-    when the type must stay on the CPU path (multimap/topic/group/bus and
-    any user-defined machine)."""
+    when the type must stay on the CPU path: topic/group/bus are
+    host-push-bound (their work is session event fan-out and out-of-band
+    transport, not state-machine compute — the device topic kernel serves
+    the raw batch path instead), and any user-defined machine has
+    arbitrary Python state."""
     from ..atomic.state import AtomicValueState
-    from ..collections.state import MapState, QueueState, SetState
+    from ..collections.state import (
+        MapState, MultiMapState, QueueState, SetState)
     from ..coordination.state import LeaderElectionState, LockState
     return {
         AtomicValueState: DeviceAtomicValueState,
         MapState: DeviceMapState,
+        MultiMapState: DeviceMultiMapState,
         SetState: DeviceSetState,
         QueueState: DeviceQueueState,
         LockState: DeviceLockState,

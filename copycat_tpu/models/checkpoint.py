@@ -75,6 +75,13 @@ def load(path: str | pathlib.Path, mesh=None):
         template = rg.state
         leaves = [data[f"leaf_{i}"] for i in range(meta["num_leaves"])]
         treedef = jax.tree_util.tree_structure(template)
+        expected = jax.tree_util.tree_leaves(template)
+        if len(leaves) < len(expected):
+            # Snapshot predates newer ResourceState pools (fields are only
+            # ever APPENDED, and `resources` is RaftState's last field, so
+            # the missing leaves are exactly the trailing ones): pad with
+            # the template's fresh (empty) pool arrays.
+            leaves = leaves + expected[len(leaves):]
         state = jax.tree_util.tree_unflatten(treedef, leaves)
         if mesh is not None:
             from ..parallel import shard_state

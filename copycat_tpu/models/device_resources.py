@@ -259,6 +259,95 @@ class DeviceQueue(DeviceResource):
         self._call(ops.OP_Q_CLEAR)
 
 
+class DeviceMultiMap(DeviceResource):
+    """Fixed-capacity int32 multimap keyed on (key, value) pairs
+    (DistributedMultiMap.java:35 / MultiMapState.java:30)."""
+
+    def put(self, key: int, value: int, ttl: int = 0) -> bool:
+        return bool(self._checked(ops.OP_MM_PUT, key, _check_value(value),
+                                  ttl))
+
+    def remove(self, key: int) -> int:
+        """Remove every entry under ``key``; returns the count removed."""
+        return self._call(ops.OP_MM_REMOVE, key)
+
+    def remove_entry(self, key: int, value: int) -> bool:
+        return bool(self._call(ops.OP_MM_REMOVE_ENTRY, key, value))
+
+    def contains_key(self, key: int) -> bool:
+        return bool(self._read(ops.OP_MM_CONTAINS_KEY, key))
+
+    def contains_entry(self, key: int, value: int) -> bool:
+        return bool(self._read(ops.OP_MM_CONTAINS_ENTRY, key, value))
+
+    def contains_value(self, value: int) -> bool:
+        return bool(self._read(ops.OP_MM_CONTAINS_VALUE, value))
+
+    def count(self, key: int) -> int:
+        """Entries under ``key`` (the reference's per-key size,
+        MultiMapState.java:169-185)."""
+        return self._read(ops.OP_MM_COUNT, key)
+
+    def size(self) -> int:
+        return self._read(ops.OP_MM_SIZE)
+
+    def is_empty(self) -> bool:
+        return bool(self._read(ops.OP_MM_IS_EMPTY))
+
+    def clear(self) -> None:
+        self._call(ops.OP_MM_CLEAR)
+
+
+class DeviceTopic(DeviceResource):
+    """Pub/sub through the log (DistributedTopic.java:61 / TopicState.java:31).
+
+    ``publish`` commits a log entry whose apply fans out ONE broadcast
+    event carrying the message; subscribers poll their group's event
+    stream. A subscriber receives messages published AFTER its subscribe
+    committed (the subscription cursor starts at the current stream
+    position) and until unsubscribe — the reference's per-session fan-out
+    semantic, with the fan-out itself done client-side at batch scale.
+    """
+
+    def __init__(self, groups, group, subscriber_id: int,
+                 session=None) -> None:
+        super().__init__(groups, group, session)
+        self.subscriber_id = subscriber_id
+        self._subscribed = False
+
+    def subscribe(self) -> None:
+        if self._subscribed:
+            return  # idempotent; must not re-drain undelivered messages
+        # Snapshot the cursor BEFORE the listen commits: everything
+        # harvested after this point is delivered. A message published in
+        # the same round but logged before the listen may be delivered
+        # spuriously (at-least-once edge); snapshotting AFTER would
+        # instead LOSE a message logged after the listen in that round.
+        evs = self._rg.events.get(self._group, [])
+        if evs:
+            self._ev_last = max(self._ev_last, evs[-1][0])
+        self._checked(ops.OP_TOPIC_LISTEN, self.subscriber_id)
+        self._subscribed = True
+
+    def unsubscribe(self) -> None:
+        self._call(ops.OP_TOPIC_UNLISTEN, self.subscriber_id)
+        self._subscribed = False
+
+    def publish(self, message: int) -> int:
+        """Publish; returns the subscriber count at the publish point."""
+        return self._call(ops.OP_TOPIC_PUB, _check_value(message))
+
+    def subscriber_count(self) -> int:
+        return self._read(ops.OP_TOPIC_COUNT)
+
+    def poll_messages(self) -> list[int]:
+        """Messages broadcast since the last poll (while subscribed)."""
+        if not self._subscribed:
+            return []
+        return [arg for _, code, _t, arg in self._events()
+                if code == ops.EV_TOPIC_MSG]
+
+
 class DeviceLock(DeviceResource):
     """Distributed mutex; grant arrives as a session event
     (DistributedLock.java:58 — completion via event, not command response).

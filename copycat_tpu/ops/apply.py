@@ -112,6 +112,27 @@ OP_ELECT_IS_LEADER = 62   # a=candidate id, b=epoch -> 0/1 (fencing check)
 OP_ELECT_LEADER = 63      # -> current leader id | -1 (authoritative)
 OP_ELECT_GET_EPOCH = 64   # -> current epoch
 
+# multimap (MultiMapState.java:30; probe table keyed on the (key, value)
+# PAIR — the device variant of the reference's nested map-of-maps)
+OP_MM_PUT = 70            # a=key, b=value, c=ttl -> 1 if added, 0 if dup
+OP_MM_REMOVE = 71         # a=key -> count of entries removed
+OP_MM_REMOVE_ENTRY = 72   # a=key, b=value -> 1 if removed
+OP_MM_CONTAINS_KEY = 73   # a=key -> 0/1
+OP_MM_CONTAINS_ENTRY = 74  # a=key, b=value -> 0/1
+OP_MM_CONTAINS_VALUE = 75  # a=value -> 0/1
+OP_MM_COUNT = 76          # a=key -> entries under key (MultiMapState.java:169)
+OP_MM_SIZE = 77           # -> total entries
+OP_MM_IS_EMPTY = 78
+OP_MM_CLEAR = 79
+
+# topic pub/sub (TopicState.java:31; publish fans out through the event
+# ring as ONE broadcast event per publish — subscribers filter by their
+# replicated membership, which this kernel tracks)
+OP_TOPIC_LISTEN = 85      # a=subscriber id -> 1 if added, 0 if already
+OP_TOPIC_UNLISTEN = 86    # a=subscriber id -> 1 if removed
+OP_TOPIC_PUB = 87         # a=message -> subscriber count at publish
+OP_TOPIC_COUNT = 88       # -> current subscriber count
+
 # Read-only opcodes servable on the fast query lane (query_step evaluates
 # and DISCARDS state, so admitting a write there would silently drop the
 # mutation while acking success — the host validates against this set).
@@ -123,12 +144,16 @@ QUERY_OPCODES = frozenset({
     OP_Q_PEEK, OP_Q_SIZE,
     OP_LOCK_HOLDER,
     OP_ELECT_IS_LEADER, OP_ELECT_LEADER, OP_ELECT_GET_EPOCH,
+    OP_MM_CONTAINS_KEY, OP_MM_CONTAINS_ENTRY, OP_MM_CONTAINS_VALUE,
+    OP_MM_COUNT, OP_MM_SIZE, OP_MM_IS_EMPTY,
+    OP_TOPIC_COUNT,
 })
 
 # --- event codes (session push, harvested from the leader lane) ------------
 EV_NONE = 0
 EV_LOCK_GRANT = 1   # target=holder id, arg=1
 EV_ELECT = 3        # target=new leader id, arg=epoch (fencing token)
+EV_TOPIC_MSG = 4    # target=-1 (broadcast), arg=message
 
 
 class ResourceConfig(NamedTuple):
@@ -146,12 +171,15 @@ class ResourceConfig(NamedTuple):
     wait_slots: int = 8       # lock wait queue (0 = try-lock only)
     listener_slots: int = 8   # election listener queue (0 = no succession)
     event_slots: int = 32     # session-event outbox ring
+    multimap_slots: int = 16  # (key, value)-pair probe table
+    topic_slots: int = 8      # topic subscriber table
 
     @classmethod
     def counters_only(cls) -> "ResourceConfig":
         """Value/long registers only — the leanest (fastest) kernel."""
         return cls(map_slots=0, set_slots=0, queue_slots=0, wait_slots=0,
-                   listener_slots=0, event_slots=0)
+                   listener_slots=0, event_slots=0, multimap_slots=0,
+                   topic_slots=0)
 
 
 class ResourceState(NamedTuple):
@@ -210,6 +238,16 @@ class ResourceState(NamedTuple):
     ev_head: jnp.ndarray    # [G,P] i32
     ev_tail: jnp.ndarray    # [G,P] i32
 
+    # multimap: probe table keyed on the (key, value) PAIR
+    mm_key: jnp.ndarray     # [G,P,M] i32
+    mm_val: jnp.ndarray     # [G,P,M] i32
+    mm_live: jnp.ndarray    # [G,P,M] bool
+    mm_dl: jnp.ndarray      # [G,P,M] i32 (0 = no TTL)
+
+    # topic: subscriber membership table
+    tp_id: jnp.ndarray      # [G,P,T] i32
+    tp_live: jnp.ndarray    # [G,P,T] bool
+
 
 def init_resources(num_groups: int, num_peers: int,
                    rc: ResourceConfig = ResourceConfig()) -> ResourceState:
@@ -236,6 +274,9 @@ def init_resources(num_groups: int, num_peers: int,
         el_live=zb(rc.listener_slots), el_head=z2, el_size=z2,
         ev_code=zi(rc.event_slots), ev_target=zi(rc.event_slots),
         ev_arg=zi(rc.event_slots), ev_head=z2, ev_tail=z2,
+        mm_key=zi(rc.multimap_slots), mm_val=zi(rc.multimap_slots),
+        mm_live=zb(rc.multimap_slots), mm_dl=zi(rc.multimap_slots),
+        tp_id=zi(rc.topic_slots), tp_live=zb(rc.topic_slots),
     )
 
 
@@ -319,8 +360,9 @@ def _ring_compact(mask: jnp.ndarray, head, size, pos, live_arr, live_win,
 #: Pool ids: entries in DIFFERENT pools commute (disjoint state), so the
 #: step's apply phase folds each pool's entries independently, touching
 #: only that pool's arrays (PERF.md "conflict-partitioned apply").
-POOL_VALUE, POOL_MAP, POOL_SET, POOL_QUEUE, POOL_LOCK, POOL_ELECT = range(6)
-NUM_POOLS = 6
+(POOL_VALUE, POOL_MAP, POOL_SET, POOL_QUEUE, POOL_LOCK, POOL_ELECT,
+ POOL_MMAP, POOL_TOPIC) = range(8)
+NUM_POOLS = 8
 POOL_NONE = NUM_POOLS  # NoOps — applied (indices advance), no pool work
 
 
@@ -339,6 +381,10 @@ def pool_of(opcode: jnp.ndarray) -> jnp.ndarray:
                      POOL_LOCK, pool)
     pool = jnp.where((opcode >= OP_ELECT_LISTEN) & (opcode <= OP_ELECT_GET_EPOCH),
                      POOL_ELECT, pool)
+    pool = jnp.where((opcode >= OP_MM_PUT) & (opcode <= OP_MM_CLEAR),
+                     POOL_MMAP, pool)
+    pool = jnp.where((opcode >= OP_TOPIC_LISTEN) & (opcode <= OP_TOPIC_COUNT),
+                     POOL_TOPIC, pool)
     return pool
 
 
@@ -680,6 +726,117 @@ def apply_elect(el, ep, eid, elv, eh, es, opcode, a, b, index, live):
         (ev_mask, ev_code, ev_target, ev_arg)
 
 
+def apply_multimap(mk, mv, ml, mdl, opcode, a, b, c, now, live):
+    """(key, value)-pair probe table; returns ((mk, mv, ml, mdl), result).
+
+    The reference's nested ``Map<Object, Map<Object, Commit>>``
+    (``MultiMapState.java:30``) flattened to pairs: membership is per
+    (key, value), removal by key drops every pair under it.
+    """
+    def op(code):
+        return live & (opcode == code)
+
+    is_mm = live & (opcode >= OP_MM_PUT) & (opcode <= OP_MM_CLEAR)
+    result = jnp.zeros_like(opcode)
+    if mk.shape[-1] == 0:
+        return (mk, mv, ml, mdl), jnp.where(is_mm, INT_MIN, result)
+
+    alive = ml & ((mdl == 0) | (mdl > now[..., None]))
+    key_hit = alive & (mk == a[..., None])
+    pair_hit = key_hit & (mv == b[..., None])
+    pair_idx, pair_any = _first_true(pair_hit)
+    free_idx, free_any = _first_true(~alive)
+    key_count = jnp.sum(key_hit, axis=-1).astype(jnp.int32)
+    total = jnp.sum(alive, axis=-1).astype(jnp.int32)
+
+    put = op(OP_MM_PUT) & ~pair_any & free_any
+    mk = _scatter3(mk, free_idx, put, a)
+    mv = _scatter3(mv, free_idx, put, b)
+    mdl = _scatter3(mdl, free_idx, put, jnp.where(c > 0, now + c, 0))
+    ml = _scatter3(ml, free_idx, put, jnp.ones_like(a, bool))
+
+    # remove-by-key drops EVERY live pair under the key in one pass
+    rm_key = op(OP_MM_REMOVE)
+    ml = jnp.where(rm_key[..., None] & key_hit, False, ml)
+    rm_pair = op(OP_MM_REMOVE_ENTRY) & pair_any
+    ml = _scatter3(ml, pair_idx, rm_pair, jnp.zeros_like(a, bool))
+    ml = jnp.where(op(OP_MM_CLEAR)[..., None], False, ml)
+    # lazy TTL purge on any touch, like the map kernel
+    ml = jnp.where(is_mm[..., None],
+                   ml & ((mdl == 0) | (mdl > now[..., None])), ml)
+
+    result = jnp.where(op(OP_MM_PUT),
+                       jnp.where(pair_any, 0,
+                                 jnp.where(free_any, 1, INT_MIN)), result)
+    result = jnp.where(rm_key, key_count, result)
+    result = jnp.where(op(OP_MM_REMOVE_ENTRY), pair_any.astype(jnp.int32),
+                       result)
+    result = jnp.where(op(OP_MM_CONTAINS_KEY),
+                       (key_count > 0).astype(jnp.int32), result)
+    result = jnp.where(op(OP_MM_CONTAINS_ENTRY), pair_any.astype(jnp.int32),
+                       result)
+    result = jnp.where(op(OP_MM_CONTAINS_VALUE),
+                       jnp.any(alive & (mv == a[..., None]),
+                               axis=-1).astype(jnp.int32), result)
+    result = jnp.where(op(OP_MM_COUNT), key_count, result)
+    result = jnp.where(op(OP_MM_SIZE), total, result)
+    result = jnp.where(op(OP_MM_IS_EMPTY), (total == 0).astype(jnp.int32),
+                       result)
+    return (mk, mv, ml, mdl), result
+
+
+def apply_topic(tid, tlive, opcode, a, b, now, live):
+    """Topic subscriber table + publish fan-out; returns
+    ((tid, tlive), result, (ev_mask, ev_code, ev_target, ev_arg)).
+
+    Publish emits ONE broadcast event carrying the message
+    (``EV_TOPIC_MSG``, target = -1); subscribers consume the group's
+    event stream and filter client-side — the reference instead pushes a
+    per-session event from ``TopicState.publish`` (``TopicState.java:31``);
+    the SPI path preserves that exact semantic via the CPU machine, this
+    kernel is the batch-scale fan-out.
+    """
+    def op(code):
+        return live & (opcode == code)
+
+    is_tp = live & (opcode >= OP_TOPIC_LISTEN) & (opcode <= OP_TOPIC_COUNT)
+    result = jnp.zeros_like(opcode)
+    ev_mask = jnp.zeros_like(live)
+    ev_code = jnp.zeros_like(opcode)
+    ev_target = jnp.zeros_like(opcode)
+    ev_arg = jnp.zeros_like(opcode)
+    if tid.shape[-1] == 0:
+        return (tid, tlive), jnp.where(is_tp, INT_MIN, result), \
+            (ev_mask, ev_code, ev_target, ev_arg)
+
+    hit = tlive & (tid == a[..., None])
+    hit_idx, hit_any = _first_true(hit)
+    free_idx, free_any = _first_true(~tlive)
+    count = jnp.sum(tlive, axis=-1).astype(jnp.int32)
+
+    sub = op(OP_TOPIC_LISTEN) & ~hit_any & free_any
+    tid = _scatter3(tid, free_idx, sub, a)
+    tlive = _scatter3(tlive, free_idx, sub, jnp.ones_like(a, bool))
+    unsub = op(OP_TOPIC_UNLISTEN) & hit_any
+    tlive = _scatter3(tlive, hit_idx, unsub, jnp.zeros_like(a, bool))
+
+    pub = op(OP_TOPIC_PUB)
+    result = jnp.where(op(OP_TOPIC_LISTEN),
+                       jnp.where(hit_any, 0,
+                                 jnp.where(free_any, 1, INT_MIN)), result)
+    result = jnp.where(op(OP_TOPIC_UNLISTEN), hit_any.astype(jnp.int32),
+                       result)
+    result = jnp.where(pub, count, result)
+    result = jnp.where(op(OP_TOPIC_COUNT), count, result)
+
+    fan = pub & (count > 0)
+    ev_mask = ev_mask | fan
+    ev_code = jnp.where(fan, EV_TOPIC_MSG, ev_code)
+    ev_target = jnp.where(fan, -1, ev_target)
+    ev_arg = jnp.where(fan, a, ev_arg)
+    return (tid, tlive), result, (ev_mask, ev_code, ev_target, ev_arg)
+
+
 def push_events(res: ResourceState, ev_mask, ev_code, ev_target, ev_arg,
                 ) -> ResourceState:
     """Push one event per lane (where ``ev_mask``) into the outbox ring,
@@ -741,10 +898,15 @@ def apply_entry(
     (el, ep, eid, elv, eh, es), r_el, ev_el = apply_elect(
         res.el_leader, res.el_epoch, res.el_id, res.el_live,
         res.el_head, res.el_size, opcode, a, b, index, live)
+    (mmk, mmv, mml, mmdl), r_mm = apply_multimap(
+        res.mm_key, res.mm_val, res.mm_live, res.mm_dl,
+        opcode, a, b, c, now, live)
+    (tid, tlv), r_tp, ev_tp = apply_topic(
+        res.tp_id, res.tp_live, opcode, a, b, now, live)
 
     # exactly one pool claims each opcode, so results merge by sum of the
     # disjoint contributions
-    result = r_val + r_map + r_set + r_q + r_lock + r_el
+    result = r_val + r_map + r_set + r_q + r_lock + r_el + r_mm + r_tp
 
     res = res._replace(
         value=value, val_dl=val_dl,
@@ -754,11 +916,14 @@ def apply_entry(
         lk_holder=holder, lk_wait_id=wid, lk_wait_dl=wdl, lk_wait_live=wlv,
         lk_head=lh, lk_size=ls,
         el_leader=el, el_epoch=ep, el_id=eid, el_live=elv, el_head=eh,
-        el_size=es)
+        el_size=es,
+        mm_key=mmk, mm_val=mmv, mm_live=mml, mm_dl=mmdl,
+        tp_id=tid, tp_live=tlv)
 
-    # grant/elect are mutually exclusive across opcodes: one event max
-    ev_mask = ev_lock[0] | ev_el[0]
-    pick = lambda i: jnp.where(ev_lock[0], ev_lock[i], ev_el[i])
+    # grant/elect/topic are mutually exclusive across opcodes: ≤1 event
+    ev_mask = ev_lock[0] | ev_el[0] | ev_tp[0]
+    pick = lambda i: jnp.where(ev_lock[0], ev_lock[i],
+                               jnp.where(ev_el[0], ev_el[i], ev_tp[i]))
     return push_events(res, ev_mask, pick(1), pick(2), pick(3)), result
 
 
@@ -903,6 +1068,10 @@ def apply_window(
         apply_lock(h, wi, wd, wl, lh, ls, op_, a_, b_, n_, lv)
     k_el = lambda el, ep, ei, el_, eh, es, op_, a_, b_, c_, i_, n_, lv: \
         apply_elect(el, ep, ei, el_, eh, es, op_, a_, b_, i_, lv)
+    k_mm = lambda mk_, mv_, ml_, md_, op_, a_, b_, c_, i_, n_, lv: \
+        apply_multimap(mk_, mv_, ml_, md_, op_, a_, b_, c_, n_, lv)
+    k_tp = lambda ti, tl, op_, a_, b_, c_, i_, n_, lv: \
+        apply_topic(ti, tl, op_, a_, b_, n_, lv)
 
     (value, val_dl), r, _ = fold(
         k_val, (res.value, res.val_dl), POOL_VALUE, 1)
@@ -926,6 +1095,13 @@ def apply_window(
         k_el, (res.el_leader, res.el_epoch, res.el_id, res.el_live,
                res.el_head, res.el_size), POOL_ELECT, 2)
     result = result + r
+    (mmk, mmv, mml, mmdl), r, _ = fold(
+        k_mm, (res.mm_key, res.mm_val, res.mm_live, res.mm_dl),
+        POOL_MMAP, 1)
+    result = result + r
+    (tid, tlv), r, ev_tp = fold(
+        k_tp, (res.tp_id, res.tp_live), POOL_TOPIC, 2)
+    result = result + r
 
     res = res._replace(
         value=value, val_dl=val_dl,
@@ -935,14 +1111,17 @@ def apply_window(
         lk_holder=holder, lk_wait_id=wid, lk_wait_dl=wdl, lk_wait_live=wlv,
         lk_head=lh, lk_size=ls,
         el_leader=el, el_epoch=ep, el_id=eid, el_live=elv, el_head=eh,
-        el_size=es)
-    # Merge the two event-producing pools by window position (disjoint —
-    # an entry belongs to one pool) and push in log order.
-    ev_mask = ev_lock[0].astype(bool) | ev_el[0].astype(bool)
+        el_size=es,
+        mm_key=mmk, mm_val=mmv, mm_live=mml, mm_dl=mmdl,
+        tp_id=tid, tp_live=tlv)
+    # Merge the event-producing pools by window position (disjoint — an
+    # entry belongs to one pool) and push in log order.
+    ev_mask = ev_lock[0].astype(bool) | ev_el[0].astype(bool) \
+        | ev_tp[0].astype(bool)
     res = push_events_window(res, ev_mask,
-                             ev_lock[1] + ev_el[1],
-                             ev_lock[2] + ev_el[2],
-                             ev_lock[3] + ev_el[3])
+                             ev_lock[1] + ev_el[1] + ev_tp[1],
+                             ev_lock[2] + ev_el[2] + ev_tp[2],
+                             ev_lock[3] + ev_el[3] + ev_tp[3])
     return res, result, admitted
 
 

@@ -64,7 +64,7 @@ def _drive(config: Config, seed: int) -> RaftGroups:
 def test_partitioned_apply_matches_sequential():
     sequential = Config(applies_per_round=8)                # legacy scan
     partitioned = sequential._replace(
-        pool_budgets=(2, 2, 2, 2, 2, 2))                    # tight budgets
+        pool_budgets=(2,) * 8)                    # tight budgets
     rg_seq = _drive(sequential, seed=99)
     rg_par = _drive(partitioned, seed=99)
 
@@ -87,7 +87,7 @@ def test_partitioned_apply_matches_sequential():
 def test_tight_budgets_still_apply_everything():
     """Budgets of 1 defer heavily but must never drop or reorder."""
     config = Config(applies_per_round=8,
-                    pool_budgets=(1, 1, 1, 1, 1, 1))
+                    pool_budgets=(1,) * 8)
     rg = RaftGroups(4, 3, log_slots=32, submit_slots=8, config=config)
     rg.wait_for_leaders()
     tags = [rg.submit(0, ap.OP_LONG_ADD, 1) for _ in range(24)]

@@ -76,3 +76,37 @@ def test_restore_preserves_event_dedup(tmp_path):
     t = restored.submit(0, ap.OP_LOCK_HOLDER)
     restored.run_until([t])
     assert restored.results[t] == 2  # ground truth: 2 holds the lock
+
+
+def test_load_snapshot_missing_newer_pool_leaves(tmp_path):
+    """A snapshot saved before newer ResourceState pools existed (fields
+    are append-only) must restore with fresh empty pools, not fail on the
+    leaf-count mismatch."""
+    import json
+
+    rg = RaftGroups(2, 3, log_slots=16)
+    rg.wait_for_leaders()
+    tag = rg.submit(0, ap.OP_LONG_ADD, 7)
+    rg.run_until([tag])
+    rg.run(5)  # let every lane (incl. peer 0) apply before snapshotting
+    path = tmp_path / "old.npz"
+    checkpoint.save(rg, path)
+
+    # rewrite the snapshot as an older version: drop the trailing 6 pool
+    # leaves (mm_key/mm_val/mm_live/mm_dl/tp_id/tp_live)
+    with np.load(str(path), allow_pickle=False) as data:
+        meta = json.loads(str(data["meta"]))
+        arrays = {k: data[k] for k in data.files if k != "meta"}
+    n = meta["num_leaves"] - 6
+    for i in range(n, meta["num_leaves"]):
+        del arrays[f"leaf_{i}"]
+    meta["num_leaves"] = n
+    old = tmp_path / "pre-multimap.npz"
+    np.savez_compressed(str(old), meta=json.dumps(meta), **arrays)
+
+    restored = checkpoint.load(old)
+    assert restored.value(0) == 7
+    # the padded pools are fresh and usable
+    t = restored.submit(0, ap.OP_MM_PUT, 1, 2)
+    restored.run_until([t])
+    assert restored.results[t] == 1

@@ -302,3 +302,36 @@ async def test_device_group_reuse_after_delete():
             assert await m.get(i + 100) == i
     finally:
         await _teardown([client] + servers)
+
+
+@pytest.mark.parametrize("executor", EXECUTORS)
+@async_test(timeout=180)
+async def test_multimap_overflow_and_mixed_payloads(executor):
+    from copycat_tpu.collections import DistributedMultiMap
+
+    servers, (client,) = await _cluster(executor)
+    try:
+        mm = await client.get("mm", DistributedMultiMap)
+        # past the device pair-table capacity (multimap_slots=16)
+        for k in range(5):
+            for v in range(5):
+                assert await mm.put(k, v * 10)
+        assert not await mm.put(0, 0)            # duplicate pair
+        assert await mm.size() == 25
+        assert await mm.size(2) == 5
+        assert sorted(await mm.get(3)) == [0, 10, 20, 30, 40]
+        # non-int32 payloads (hashable, as the reference requires)
+        assert await mm.put("sk", "sv")
+        assert await mm.contains_entry("sk", "sv")
+        assert await mm.contains_value("sv")
+        # remove-entry and remove-key across the device/shadow boundary
+        assert await mm.remove(1, 10)            # remove one entry
+        assert not await mm.contains_entry(1, 10)
+        removed = await mm.remove(4)             # remove whole key
+        assert sorted(removed) == [0, 10, 20, 30, 40]
+        assert not await mm.contains_key(4)
+        assert await mm.size() == 20             # 25 - 1 - 5 + 1(sk)
+        await mm.clear()
+        assert await mm.is_empty()
+    finally:
+        await _teardown([client] + servers)
