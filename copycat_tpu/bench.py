@@ -406,8 +406,15 @@ def run_election() -> dict:
 
 def run_map_read() -> dict:
     """Config #3 variant, get-heavy: puts ride the log, gets ride the
-    query lane (leader-served SEQUENTIAL reads, no log append) — the
-    reference's sub-ATOMIC query routing at batch scale."""
+    query lane with no log append — SEQUENTIAL (leader-served) by
+    default, or lease-gated ATOMIC/BOUNDED_LINEARIZABLE reads with
+    ``COPYCAT_BENCH_READ_LEVEL=atomic`` (reference
+    ``Consistency.java:157-176``)."""
+    read_level = os.environ.get("COPYCAT_BENCH_READ_LEVEL", "sequential")
+    if read_level not in ("sequential", "atomic"):
+        raise SystemExit(
+            f"COPYCAT_BENCH_READ_LEVEL={read_level!r}: pick 'sequential' "
+            f"or 'atomic' (a typo here would silently mislabel the metric)")
     config = Config(use_pallas=USE_PALLAS, append_window=max(4, SUBMIT_SLOTS),
                     applies_per_round=max(4, SUBMIT_SLOTS),
                     resource=RESOURCE_CONFIGS["map"])
@@ -423,17 +430,20 @@ def run_map_read() -> dict:
     jit_step = jax.jit(partial(step, config=config))
 
     log(f"bench[map_read]: G={GROUPS} P={PEERS} rounds={ROUNDS} "
-        f"{SUBMIT_SLOTS} puts (log) + {SUBMIT_SLOTS} gets (query lane) "
-        f"per group per round; device={jax.devices()[0].platform}")
+        f"{SUBMIT_SLOTS} puts (log) + {SUBMIT_SLOTS} {read_level} gets "
+        f"(query lane) per group per round; "
+        f"device={jax.devices()[0].platform}")
     state, key = elect_all(state, jit_step, empty_submits(GROUPS), deliver,
                            key, GROUPS)
+    atomic = (jnp.ones((GROUPS, SUBMIT_SLOTS), bool)
+              if read_level == "atomic" else None)
 
     def run(state, key):
         def body(carry, _):
             state, key, applied_prev = carry
             key, k = jax.random.split(key)
             state, _ = step(state, puts, deliver, k, config=config)
-            _, served = query_step(state, gets, config=config)
+            _, served = query_step(state, gets, atomic, config=config)
             applied_now = jnp.max(state.applied_index, axis=1)
             n = jnp.sum(applied_now - applied_prev, dtype=jnp.int32) \
                 + served.sum(dtype=jnp.int32)
@@ -461,7 +471,8 @@ def run_map_read() -> dict:
             f"-> {ops:,.0f} ops/sec ({dt / ROUNDS * 1e3:.2f} ms/round)")
 
     return {
-        "metric": (f"map_ops_per_sec_{GROUPS}_groups_half_sequential_reads"),
+        "metric": (f"map_ops_per_sec_{GROUPS}_groups_half_"
+                   f"{read_level}_reads"),
         "value": round(best, 1),
         "unit": "ops/sec",
         "vs_baseline": round(best / NORTH_STAR_OPS, 4),

@@ -22,10 +22,21 @@ from ..ops.consensus import Config
 from ..ops.apply import ResourceConfig
 
 
+def _leaf_name(path) -> str:
+    """Dotted field path of a pytree leaf ('resources.mm_key', 'term')."""
+    return ".".join(getattr(p, "name", str(p)) for p in path)
+
+
 def save(rg, path: str | pathlib.Path) -> None:
-    """Snapshot a ``RaftGroups`` driver to ``path`` (.npz)."""
-    leaves, treedef = jax.tree_util.tree_flatten(rg.state)
-    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    """Snapshot a ``RaftGroups`` driver to ``path`` (.npz).
+
+    State leaves are stored BY FIELD PATH (``state.resources.mm_key``),
+    not positionally, so restoring stays correct no matter where future
+    fields are inserted in ``RaftState``/``ResourceState`` — a missing
+    (newer) field simply keeps the fresh template value on load.
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(rg.state)
+    arrays = {f"state.{_leaf_name(p)}": np.asarray(x) for p, x in flat}
     meta = {
         "num_groups": rg.num_groups,
         "num_peers": rg.num_peers,
@@ -44,7 +55,7 @@ def save(rg, path: str | pathlib.Path) -> None:
         # events die with the session) and re-query authoritative state.
         "events": {str(g): evs for g, evs in rg.events.items()},
         "key": np.asarray(rg._key).tolist(),
-        "num_leaves": len(leaves),
+        "num_leaves": len(flat),
     }
     arrays["deliver"] = np.asarray(rg.deliver)
     np.savez_compressed(str(path), meta=json.dumps(meta), **arrays)
@@ -73,15 +84,24 @@ def load(path: str | pathlib.Path, mesh=None):
                         submit_slots=meta["submit_slots"],
                         config=config, mesh=mesh)
         template = rg.state
-        leaves = [data[f"leaf_{i}"] for i in range(meta["num_leaves"])]
         treedef = jax.tree_util.tree_structure(template)
-        expected = jax.tree_util.tree_leaves(template)
-        if len(leaves) < len(expected):
-            # Snapshot predates newer ResourceState pools (fields are only
-            # ever APPENDED, and `resources` is RaftState's last field, so
-            # the missing leaves are exactly the trailing ones): pad with
-            # the template's fresh (empty) pool arrays.
-            leaves = leaves + expected[len(leaves):]
+        if any(k.startswith("state.") for k in data.files):
+            # Path-keyed format: robust to fields inserted ANYWHERE — a
+            # field absent from the snapshot keeps its fresh template
+            # value (e.g. a pool added after the snapshot was taken).
+            flat = jax.tree_util.tree_flatten_with_path(template)[0]
+            leaves = [data[f"state.{_leaf_name(p)}"]
+                      if f"state.{_leaf_name(p)}" in data else np.asarray(x)
+                      for p, x in flat]
+        else:
+            # Legacy positional format (leaf_0..leaf_N in the field order
+            # of the SAVING code). Fields were strictly appended while
+            # this format was in use, so missing leaves are the trailing
+            # ones: pad with the template's fresh arrays.
+            leaves = [data[f"leaf_{i}"] for i in range(meta["num_leaves"])]
+            expected = jax.tree_util.tree_leaves(template)
+            if len(leaves) < len(expected):
+                leaves = leaves + expected[len(leaves):]
         state = jax.tree_util.tree_unflatten(treedef, leaves)
         if mesh is not None:
             from ..parallel import shard_state

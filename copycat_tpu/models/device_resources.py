@@ -61,11 +61,11 @@ class DeviceResource:
         # registers instead (OP_LOCK_HOLDER / OP_ELECT_LEADER fallbacks).
         evs = groups.events.get(group, [])
         self._ev_last = evs[-1][0] if evs else -1
-        # ATOMIC routes reads through the log (linearizable); SEQUENTIAL
-        # serves them from the leader's applied state on the query lane
-        # (no log append) — the reference's Consistency mapping
-        # (Consistency.java:60-176: ATOMIC→LINEARIZABLE reads,
-        # SEQUENTIAL/PROCESS→leader-served reads without consensus).
+        # Both read levels ride the query lane (no log append): ATOMIC
+        # additionally requires the leader LEASE (quorum-acked latest
+        # round — BOUNDED_LINEARIZABLE, Consistency.java:157-176) and
+        # escalates to a quorum-committed command when the lease is
+        # absent; SEQUENTIAL serves from the leader's applied state.
         self.consistency = "atomic"
 
     def with_consistency(self, level: str) -> "DeviceResource":
@@ -102,12 +102,17 @@ class DeviceResource:
         return self._run_until(self._rg.submit(self._group, opcode, a, b, c))
 
     def _read(self, opcode: int, a: int = 0, b: int = 0, c: int = 0) -> int:
-        """Route a read-only op by the configured consistency level."""
-        if self.consistency == "atomic":
-            return self._call(opcode, a, b, c)
+        """Route a read-only op by the configured consistency level.
+
+        ATOMIC reads ride the lease-gated query lane (no log append; the
+        leader lease certifies BOUNDED_LINEARIZABLE freshness) and
+        escalate to a quorum-committed command automatically when the
+        lease is absent — the reference's ATOMIC read level
+        (``Consistency.java:157-176``)."""
         self._touch()
-        return self._run_until(
-            self._rg.submit_query(self._group, opcode, a, b, c))
+        level = "atomic" if self.consistency == "atomic" else "sequential"
+        return self._run_until(self._rg.submit_query(
+            self._group, opcode, a, b, c, consistency=level))
 
     def _checked(self, *args) -> int:
         result = self._call(*args)

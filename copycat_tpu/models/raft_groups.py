@@ -85,6 +85,7 @@ class RaftGroups:
         self._step, self._query, self._install = _jitted_programs(self.config)
         self._queues: dict[int, deque] = {}
         self._query_queues: dict[int, deque] = {}
+        self._query_atomic: set[int] = set()  # tags needing the lease gate
         self._next_tag = 1
         self._inflight: dict[int, tuple[int, int]] = {}  # tag -> (group, round)
         self.results: dict[int, int] = {}    # tag -> result
@@ -130,23 +131,30 @@ class RaftGroups:
         return tag
 
     def submit_query(self, group: int, opcode: int, a: int = 0, b: int = 0,
-                     c: int = 0) -> int:
+                     c: int = 0, consistency: str = "sequential") -> int:
         """Queue a read-only op on the fast query lane (no log append).
 
-        Served from the leader's applied state at SEQUENTIAL consistency
-        (the reference's sub-ATOMIC query routing, ``Consistency.java``);
-        escalates to the command path automatically when no current leader
-        can serve it. Resolves in ``results`` like :meth:`submit`."""
+        ``consistency="sequential"`` serves from the leader's applied
+        state (the reference's sub-ATOMIC query routing,
+        ``Consistency.java``); ``"atomic"`` additionally requires the
+        leader LEASE (quorum-acked latest round) — BOUNDED_LINEARIZABLE
+        reads without a log entry (``Consistency.java:157-176``). Either
+        escalates to the command path automatically when unservable.
+        Resolves in ``results`` like :meth:`submit`."""
         from ..ops.apply import QUERY_OPCODES
         if opcode not in QUERY_OPCODES:
             # query_step discards state: a write here would be silently
             # dropped while acking success (reference rejects them too)
             raise ValueError(
                 f"opcode {opcode} is not read-only; submit it as a command")
+        if consistency not in ("sequential", "atomic"):
+            raise ValueError(f"unknown query consistency {consistency!r}")
         tag = self._next_tag
         self._next_tag += 1
         self._query_queues.setdefault(group, deque()).append(
             (opcode, a, b, c, tag))
+        if consistency == "atomic":
+            self._query_atomic.add(tag)
         self._inflight[tag] = (group, self.rounds)
         self.metrics.counter("queries_submitted").inc()
         return tag
@@ -216,7 +224,8 @@ class RaftGroups:
         return out
 
     def serve_query(self, group: int, opcode: int, a: int = 0, b: int = 0,
-                    c: int = 0, max_attempts: int = 50) -> int:
+                    c: int = 0, max_attempts: int = 50,
+                    consistency: str = "sequential") -> int:
         """Serve ONE read-only op from the leader's applied state, never
         touching the log (unlike :meth:`submit_query`, whose unserved
         slots escalate to the command path and append an entry).
@@ -237,8 +246,10 @@ class RaftGroups:
         sub.b[group, 0] = b
         sub.c[group, 0] = c
         sub.valid[group, 0] = True
+        atomic = np.zeros_like(sub.valid)
+        atomic[group, 0] = consistency == "atomic"
         for _ in range(max_attempts):
-            results, served = self._query(self.state, sub)
+            results, served = self._query(self.state, sub, atomic)
             if bool(np.asarray(served)[group, 0]):
                 self.metrics.counter("queries_served").inc()
                 return int(np.asarray(results)[group, 0])
@@ -252,20 +263,26 @@ class RaftGroups:
         escalates to the command path — same consistency, one log entry."""
         sub = self._empty_submits()
         placed = self._drain_into(self._query_queues, sub)
-        results, served = self._query(self.state, sub)
+        atomic = np.zeros_like(sub.valid)
+        for g, s in placed:
+            if int(sub.tag[g, s]) in self._query_atomic:
+                atomic[g, s] = True
+        results, served = self._query(self.state, sub, atomic)
         results = np.asarray(results)
         served = np.asarray(served)
         fell_back = self.metrics.counter("queries_escalated")
         done = self.metrics.counter("queries_served")
         for g, s in placed:
             tag = int(sub.tag[g, s])
+            self._query_atomic.discard(tag)
             if served[g, s]:
                 if tag in self._inflight:
                     self._inflight.pop(tag)
                     self.results[tag] = int(results[g, s])
                     done.inc()
             else:
-                # escalate: re-enter as a command (quorum-committed read)
+                # escalate: re-enter as a command (quorum-committed read —
+                # always at least as strong as the requested level)
                 self._queues.setdefault(g, deque()).append(
                     (int(sub.opcode[g, s]), int(sub.a[g, s]),
                      int(sub.b[g, s]), int(sub.c[g, s]), tag))

@@ -80,6 +80,17 @@ class RaftState(NamedTuple):
     log_time: jnp.ndarray      # [G,P,L] i32 logical timestamp at append
     log_tag: jnp.ndarray       # [G,P,L] i32 host correlation tag
     resources: ResourceState
+    # Leader lease (appended last — checkpoint leaf padding relies on new
+    # fields being strictly trailing): True iff the current leader
+    # received same-term acks from a QUORUM in the latest round. Sound in
+    # the synchronous round model: a competing leader elected by round R
+    # needs a majority of voters at a higher term, any quorum of
+    # same-term acks must intersect that majority, and the intersecting
+    # node's higher-term reject would have cleared the lease — so a held
+    # lease proves no other leader could have committed anything yet,
+    # which is exactly the freshness BOUNDED_LINEARIZABLE reads need
+    # (reference Consistency.java:157-176) without a log append.
+    lease: jnp.ndarray         # [G,P] bool (replicated per lane)
 
 
 class Submits(NamedTuple):
@@ -158,6 +169,7 @@ def init_state(num_groups: int, num_peers: int, log_slots: int,
         log_term=zl, log_op=zl, log_a=zl, log_b=zl, log_c=zl,
         log_time=zl, log_tag=zl,
         resources=init_resources(G, P, config.resource),
+        lease=jnp.zeros((G, P), bool),
     )
 
 
@@ -275,27 +287,33 @@ def current_leader(state: RaftState) -> tuple[jnp.ndarray, jnp.ndarray]:
 
 
 def query_step(state: RaftState, queries: Submits,
+               atomic: jnp.ndarray | None = None,
                config: Config = Config()) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Serve read-only ops from the leader's applied state — no log append.
 
     The reference serves CAUSAL/SEQUENTIAL queries without consensus
-    (``Consistency.java:45-126``: only ATOMIC reads pay for quorum); the
-    CPU oracle routes the same way (``server/raft.py`` query routing).
-    This is the device equivalent: a separate tiny program (no state
-    output — nothing is written back) that evaluates query opcodes against
-    the leader lane's resource pools. Serving is gated on the lane being a
-    current leader that (a) has applied everything it committed AND (b)
-    has committed an entry of its OWN term — a freshly elected leader's
-    commit index can trail its predecessor's served state until its
-    election no-op commits (Raft §8), and serving before that could hand a
-    client state older than a read it already observed. With the gate,
-    reads are sequential: leader-local and monotone per group. ATOMIC
-    reads keep the full log path.
+    (``Consistency.java:45-126``); this is the device equivalent: a
+    separate tiny program (no state output — nothing is written back)
+    that evaluates query opcodes against the leader lane's resource
+    pools. Serving is gated on the lane being a current leader that (a)
+    has applied everything it committed AND (b) has committed an entry of
+    its OWN term — a freshly elected leader's commit index can trail its
+    predecessor's served state until its election no-op commits (Raft
+    §8), and serving before that could hand a client state older than a
+    read it already observed. With the gate, reads are sequential:
+    leader-local and monotone per group.
+
+    ``atomic`` ([G,S] bool, optional) marks slots needing
+    BOUNDED_LINEARIZABLE freshness (the reference's ATOMIC read level,
+    ``Consistency.java:157-176``): those are additionally gated on the
+    leader LEASE (quorum-acked in the latest round — ``RaftState.lease``),
+    which certifies no other leader could have committed anything, so the
+    read linearizes at the lease round without a log append.
 
     Returns ``(results [G,S], served [G,S] bool)`` — unserved slots (no
-    leader, fresh leader, or applied < commit) must be retried or
-    escalated to the command path by the caller (models/raft_groups.py
-    does the latter).
+    leader, fresh leader, applied < commit, or no lease for an atomic
+    slot) must be retried or escalated to the command path by the caller
+    (models/raft_groups.py does the latter).
     """
     G = state.term.shape[0]
     S = queries.valid.shape[1]
@@ -308,6 +326,9 @@ def query_step(state: RaftState, queries: Submits,
     commit_term = _term_at_2d(l_log_term, l_last, l_commit[:, None])[:, 0]
     current = active & (l_applied >= l_commit) & (commit_term == l_term)
     served = queries.valid & current[:, None]
+    if atomic is not None:
+        leased = jnp.any(state.lease, axis=1)
+        served = served & (~atomic | leased[:, None])
 
     # Leader-lane view of every pool, broadcast over the S query slots so
     # the shape-generic apply kernel evaluates ALL slots in one fused pass
@@ -488,6 +509,11 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
                        jnp.clip(jnp.minimum(prev, hint + 1), 1, None), l_next)
 
     self_lane = peer_ids[None, :] == lead[:, None]
+    # Leader lease: a quorum of same-term acks THIS round (self included)
+    # with no higher term observed — see RaftState.lease for why this
+    # certifies exclusive leadership through this round.
+    acked = jnp.sum(ack_success | self_lane, axis=1)
+    lease_g = active & ~leader_stale & (acked >= quorum)
     match_full = jnp.where(self_lane, l_last[:, None], l_match)
     cand_commit = _kth(match_full, quorum)
     cand_commit_term = _term_at_2d(l_log_term, l_last, cand_commit[:, None])[:, 0]
@@ -642,7 +668,8 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
         next_index=next2, match_index=match2,
         log_term=log_term2, log_op=log_op2, log_a=log_a2, log_b=log_b2,
         log_c=log_c2, log_time=log_time2,
-        log_tag=log_tag2, resources=resources)
+        log_tag=log_tag2, resources=resources,
+        lease=jnp.broadcast_to(lease_g[:, None], (G, P)))
     outputs = StepOutputs(
         accepted=accepted, out_valid=out_valid, out_tag=out_tag,
         out_result=out_result, out_latency=out_latency, leader=lead,

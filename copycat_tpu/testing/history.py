@@ -21,9 +21,19 @@ class HistoryRecorder:
         self._done: dict[int, list[HOp]] = {}
 
     def invoke(self, group: int, opcode: int, model_op: tuple,
-               a: int = 0, b: int = 0, c: int = 0) -> int:
-        """Submit a device op and start its history window."""
-        tag = self._rg.submit(group, opcode, a, b, c)
+               a: int = 0, b: int = 0, c: int = 0,
+               query: str | None = None) -> int:
+        """Submit a device op and start its history window.
+
+        ``query="atomic"`` routes a read through the lease-gated query
+        lane instead of the log (``query="sequential"`` for the plain
+        leader-served lane) — the checker then validates the lease reads
+        against real time like any other op."""
+        if query is not None:
+            tag = self._rg.submit_query(group, opcode, a, b, c,
+                                        consistency=query)
+        else:
+            tag = self._rg.submit(group, opcode, a, b, c)
         self._pending[tag] = (group, model_op, self._rg.rounds)
         return tag
 

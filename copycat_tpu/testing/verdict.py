@@ -46,7 +46,11 @@ def _invoke_register(rec: HistoryRecorder, g: int, rng) -> None:
         v = int(rng.integers(1, 50))
         rec.invoke(g, ap.OP_VALUE_SET, ("set", v), a=v)
     elif kind == 1:
-        rec.invoke(g, ap.OP_VALUE_GET, ("get",))
+        # half the reads ride the lease-gated ATOMIC query lane (no log
+        # append) — the checker validates them against real time, which
+        # is exactly the leader-lease soundness claim under test
+        query = "atomic" if rng.random() < 0.5 else None
+        rec.invoke(g, ap.OP_VALUE_GET, ("get",), query=query)
     elif kind == 2:
         e, u = int(rng.integers(0, 50)), int(rng.integers(1, 50))
         rec.invoke(g, ap.OP_VALUE_CAS, ("cas", e, u), a=e, b=u)

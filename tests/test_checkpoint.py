@@ -79,34 +79,53 @@ def test_restore_preserves_event_dedup(tmp_path):
 
 
 def test_load_snapshot_missing_newer_pool_leaves(tmp_path):
-    """A snapshot saved before newer ResourceState pools existed (fields
-    are append-only) must restore with fresh empty pools, not fail on the
-    leaf-count mismatch."""
+    """Snapshots saved before newer ResourceState pools/fields existed must
+    restore with fresh template values — both the legacy positional
+    format (trailing-leaf padding) and the path-keyed format (missing
+    fields keep template values)."""
     import json
+
+    import jax
 
     rg = RaftGroups(2, 3, log_slots=16)
     rg.wait_for_leaders()
     tag = rg.submit(0, ap.OP_LONG_ADD, 7)
     rg.run_until([tag])
     rg.run(5)  # let every lane (incl. peer 0) apply before snapshotting
-    path = tmp_path / "old.npz"
+    path = tmp_path / "now.npz"
     checkpoint.save(rg, path)
 
-    # rewrite the snapshot as an older version: drop the trailing 6 pool
-    # leaves (mm_key/mm_val/mm_live/mm_dl/tp_id/tp_live)
     with np.load(str(path), allow_pickle=False) as data:
         meta = json.loads(str(data["meta"]))
         arrays = {k: data[k] for k in data.files if k != "meta"}
-    n = meta["num_leaves"] - 6
-    for i in range(n, meta["num_leaves"]):
-        del arrays[f"leaf_{i}"]
-    meta["num_leaves"] = n
-    old = tmp_path / "pre-multimap.npz"
-    np.savez_compressed(str(old), meta=json.dumps(meta), **arrays)
 
-    restored = checkpoint.load(old)
+    # (a) path-keyed format with newer fields missing entirely
+    partial = {k: v for k, v in arrays.items()
+               if not any(f in k for f in ("mm_", "tp_", "lease"))}
+    old_pk = tmp_path / "path-keyed-old.npz"
+    np.savez_compressed(str(old_pk), meta=json.dumps(meta), **partial)
+    restored = checkpoint.load(old_pk)
     assert restored.value(0) == 7
-    # the padded pools are fresh and usable
     t = restored.submit(0, ap.OP_MM_PUT, 1, 2)
     restored.run_until([t])
     assert restored.results[t] == 1
+
+    # (b) legacy positional format (leaf_i), truncated before mm/tp/lease
+    flat = jax.tree_util.tree_flatten_with_path(rg.state)[0]
+    legacy = {k: v for k, v in arrays.items() if not k.startswith("state.")}
+    n = 0
+    for path_keys, leaf in flat:
+        name = "state." + ".".join(
+            getattr(pk, "name", str(pk)) for pk in path_keys)
+        if any(f in name for f in ("mm_", "tp_", "lease")):
+            continue
+        legacy[f"leaf_{n}"] = arrays[name]
+        n += 1
+    meta["num_leaves"] = n
+    old_pos = tmp_path / "positional-old.npz"
+    np.savez_compressed(str(old_pos), meta=json.dumps(meta), **legacy)
+    restored2 = checkpoint.load(old_pos)
+    assert restored2.value(0) == 7
+    t2 = restored2.submit(0, ap.OP_MM_PUT, 3, 4)
+    restored2.run_until([t2])
+    assert restored2.results[t2] == 1

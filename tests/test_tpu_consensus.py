@@ -245,3 +245,38 @@ def test_out_latency_tracks_append_to_apply_lag():
     assert lats, "no applied entries observed"
     L = rg.log_slots
     assert all(0 <= x <= L for x in lats), lats
+
+
+def test_leader_lease_tracks_quorum_contact():
+    """The lease bit must be HELD under full delivery and CLEARED within
+    one round of the leader losing contact with a quorum — the
+    falsifiable core of the BOUNDED_LINEARIZABLE read gate (a served
+    atomic read relies on exactly this bit)."""
+    import numpy as np
+
+    from copycat_tpu.models.raft_groups import RaftGroups
+
+    rg = RaftGroups(4, 3, log_slots=32, seed=2)
+    leaders = rg.wait_for_leaders()
+    rg.run(2)
+    assert bool(np.asarray(rg.state.lease).any(axis=1).all()), \
+        "full delivery must hold every group's lease"
+
+    # isolate group 0's leader from BOTH followers: next round it cannot
+    # assemble a quorum of acks, so its lease must drop (groups 1..3 keep
+    # theirs)
+    deliver = np.ones((4, 3, 3), bool)
+    lead0 = int(leaders[0])
+    deliver[0, lead0, :] = False
+    deliver[0, :, lead0] = False
+    deliver[0, lead0, lead0] = True
+    rg.deliver = __import__("jax").numpy.asarray(deliver)
+    rg.run(1)
+    lease = np.asarray(rg.state.lease).any(axis=1)
+    assert not lease[0], "isolated leader must lose the lease immediately"
+    assert lease[1:].all(), "connected groups keep their leases"
+
+    # heal: the lease returns once a quorum acks again
+    rg.deliver = __import__("jax").numpy.asarray(np.ones((4, 3, 3), bool))
+    rg.run(3)
+    assert np.asarray(rg.state.lease).any(axis=1).all()
