@@ -1,0 +1,121 @@
+"""Sharded-step scaling measurement over a virtual CPU device mesh.
+
+Round-2 review: "no artifact shows the step's scaling behavior across the
+virtual mesh — even a CPU-mesh walltime table would expose a
+collective-placement pathology before real multi-chip hardware arrives."
+This runner produces that artifact: the SAME consensus step (fixed total
+work) jitted over 1/2/4/8-device meshes, group axis sharded, walltime per
+round measured after warm-up. CPU devices share host cores, so the point
+is not speedup — it is that walltime stays ~flat (no superlinear blow-up
+from XLA inserting pathological collectives or resharding on the step's
+dataflow) and that the compiled program report shows the expected
+communication pattern.
+
+Run: ``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+python -m copycat_tpu.parallel.scaling`` → one JSON line + MULTICHIP_SCALING.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+# must land before the first backend init
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+import numpy as np
+
+GROUPS = int(os.environ.get("COPYCAT_SCALING_GROUPS", "4096"))
+PEERS = 3
+ROUNDS = int(os.environ.get("COPYCAT_SCALING_ROUNDS", "30"))
+
+
+def _measure(n_devices: int, devices) -> dict:
+    from functools import partial
+
+    from jax.sharding import Mesh
+
+    from ..ops.consensus import (
+        Config, full_delivery, init_state, make_submits, step)
+    from ..parallel.mesh import shard_state, shard_step_inputs
+
+    mesh = Mesh(np.asarray(devices[:n_devices]), ("groups",))
+    config = Config()
+    key = jax.random.PRNGKey(0)
+    key, init_key = jax.random.split(key)
+    state = init_state(GROUPS, PEERS, 32, init_key, config)
+    submits = make_submits(GROUPS, 4)
+    deliver = full_delivery(GROUPS, PEERS)
+    state = shard_state(state, mesh)
+    submits, deliver = shard_step_inputs(submits, deliver, mesh)
+    fn = jax.jit(partial(step, config=config))
+
+    t0 = time.perf_counter()
+    for _ in range(3):  # warm-up (includes compile)
+        key, k = jax.random.split(key)
+        state, out = fn(state, submits, deliver, k)
+    jax.block_until_ready(state)
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(ROUNDS):
+        key, k = jax.random.split(key)
+        state, out = fn(state, submits, deliver, k)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    return {"devices": n_devices,
+            "ms_per_round": round(dt / ROUNDS * 1e3, 2),
+            "warmup_s": round(compile_s, 1)}
+
+
+def main() -> None:
+    devices = jax.devices("cpu")
+    if len(devices) < 8:
+        raise SystemExit("need 8 virtual CPU devices (set XLA_FLAGS before "
+                         "any jax import)")
+    rows = [_measure(n, devices) for n in (1, 2, 4, 8)]
+    base = rows[0]["ms_per_round"]
+    for row in rows:
+        row["vs_1dev"] = round(row["ms_per_round"] / base, 2)
+    result = {"groups": GROUPS, "peers": PEERS, "rounds": ROUNDS,
+              "mesh_axis": "groups", "table": rows}
+
+    lines = [
+        "# MULTICHIP_SCALING — sharded step walltime over the virtual mesh",
+        "",
+        f"Fixed total work ({GROUPS} groups × {PEERS} peers, full default",
+        "pools) jitted over 1/2/4/8 virtual CPU devices, group axis",
+        "sharded (`copycat_tpu/parallel/mesh.py`), measured with",
+        "`python -m copycat_tpu.parallel.scaling`. Virtual CPU devices",
+        "share host cores, so flat-or-better walltime is the pass",
+        "criterion: it shows XLA's inserted collectives stay proportional",
+        "(no resharding pathology on the step's dataflow) before real",
+        "multi-chip hardware is ever involved.",
+        "",
+        "| devices | ms/round | vs 1 device |",
+        "|---|---|---|",
+    ]
+    for row in rows:
+        lines.append(f"| {row['devices']} | {row['ms_per_round']} "
+                     f"| {row['vs_1dev']}× |")
+    lines += [
+        "",
+        "The peer axis stays replicated here (P=3 quorum tallies are",
+        "cheap reductions); `__graft_entry__.dryrun_multichip` separately",
+        "proves the 2D ('groups','peers') sharding compiles and elects",
+        "across the mesh every round.",
+        "",
+    ]
+    with open("MULTICHIP_SCALING.md", "w") as f:
+        f.write("\n".join(lines))
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
