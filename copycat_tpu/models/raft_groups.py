@@ -60,6 +60,7 @@ class RaftGroups:
         config: Config | None = None,
         seed: int = 0,
         mesh: Any | None = None,
+        voters: int | None = None,
     ) -> None:
         self.num_groups = num_groups
         self.num_peers = num_peers
@@ -67,11 +68,22 @@ class RaftGroups:
         self.submit_slots = submit_slots
         self.config = config or Config()
         self.mesh = mesh
+        members = None
+        if voters is not None:
+            if not 0 < voters <= num_peers:
+                raise ValueError(f"voters={voters} outside 1..{num_peers}")
+            if voters < num_peers and not self.config.dynamic_membership:
+                raise ValueError(
+                    "voters < num_peers needs Config(dynamic_membership"
+                    "=True) — the static step tallies all P lanes")
+            if voters < num_peers:
+                members = np.arange(num_peers) < voters
 
         key = jax.random.PRNGKey(seed)
         self._key, init_key = jax.random.split(key)
         self.state: RaftState = init_state(num_groups, num_peers, log_slots,
-                                           init_key, self.config)
+                                           init_key, self.config,
+                                           members=members)
         self.deliver = full_delivery(num_groups, num_peers)
         if mesh is not None:
             from ..parallel import shard_state, shard_step_inputs
@@ -88,6 +100,32 @@ class RaftGroups:
         self._query_atomic: set[int] = set()  # tags needing the lease gate
         self._next_tag = 1
         self._inflight: dict[int, tuple[int, int]] = {}  # tag -> (group, round)
+        # exactly-once retry (queue-managed ops only): an op accepted into
+        # a leader log can still be LOST — a partitioned leader's
+        # unreplicated tail is overwritten by its successor. The host
+        # re-submits only on PROOF of loss: once an entry with term
+        # T > term_e applies at index j ≤ idx, the pending placement
+        # (idx, term_e) can never be in the committed log (log terms are
+        # monotone, so its log had term ≤ term_e < T at j — prefix
+        # mismatch), hence re-submitting cannot double-apply. The
+        # device-path analogue of the reference's session-sequenced
+        # client resubmit (Copycat client runtime, SURVEY §2.3).
+        self._inflight_ops: dict[int, tuple[int, int, int, int]] = {}
+        # group -> {index -> (tag, append term)} — current placements only
+        self._placements: dict[int, dict[int, tuple[int, int]]] = {}
+        self._tag_index: dict[int, tuple[int, int]] = {}  # tag -> (group, idx)
+        # highest post-round leader term observed per group: while a
+        # placement's append term is older, that op's fate is uncertain
+        # (its leader changed) and the group's queue is HELD — new ops
+        # must not land in a log line that may lack an earlier op, or
+        # per-group FIFO completion (the reference's session program
+        # order) would break. The held set and the per-group min pending
+        # append term (a lower bound — left stale on removals, refreshed
+        # during loss scans) are maintained incrementally so the steady
+        # state (no leader changes) costs no per-round Python scans.
+        self._leader_term = np.zeros(num_groups, np.int64)
+        self._held: set[int] = set()
+        self._pend_min: dict[int, int] = {}
         self.results: dict[int, int] = {}    # tag -> result
         self.rounds = 0
         # first-class ops/sec + latency metrics (SURVEY.md §5.5)
@@ -123,10 +161,23 @@ class RaftGroups:
     def submit(self, group: int, opcode: int, a: int = 0, b: int = 0,
                c: int = 0) -> int:
         """Queue one op; returns a correlation tag resolved in ``results``."""
+        from ..ops.apply import OP_CFG_ADD, OP_CFG_REMOVE
+        if opcode in (OP_CFG_ADD, OP_CFG_REMOVE):
+            # raw config submits get the same validation as
+            # add_peer/remove_peer — otherwise an out-of-range lane or a
+            # static-membership engine would commit a no-op entry that
+            # resolves as a silent success
+            if not self.config.dynamic_membership:
+                raise ValueError("membership changes need "
+                                 "Config(dynamic_membership=True)")
+            if not 0 <= a < self.num_peers:
+                raise ValueError(
+                    f"peer {a} outside 0..{self.num_peers - 1}")
         tag = self._next_tag
         self._next_tag += 1
         self._queues.setdefault(group, deque()).append((opcode, a, b, c, tag))
         self._inflight[tag] = (group, self.rounds)
+        self._inflight_ops[tag] = (opcode, a, b, c)
         self.metrics.counter("ops_submitted").inc()
         return tag
 
@@ -159,12 +210,30 @@ class RaftGroups:
         self.metrics.counter("queries_submitted").inc()
         return tag
 
-    def _drain_into(self, queues: dict[int, deque],
-                    sub: Submits) -> list[tuple[int, int]]:
+    def _drop_placement(self, g: int, idx: int) -> None:
+        """Remove one placement; prune empty per-group state and
+        re-evaluate the group's hold."""
+        pend = self._placements.get(g)
+        if pend is None:
+            return
+        pend.pop(idx, None)
+        if not pend:
+            del self._placements[g]
+            self._pend_min.pop(g, None)
+            self._held.discard(g)
+        elif g in self._held:
+            lt = self._leader_term[g]
+            if all(te >= lt for _, te in pend.values()):
+                self._held.discard(g)
+
+    def _drain_into(self, queues: dict[int, deque], sub: Submits,
+                    skip: set[int] | None = None) -> list[tuple[int, int]]:
         """Pop up to ``submit_slots`` queued ops per group into ``sub``;
         returns the (group, slot) pairs filled."""
         placed: list[tuple[int, int]] = []
         for g, q in list(queues.items()):
+            if skip and g in skip:
+                continue
             for s in range(self.submit_slots):
                 if not q:
                     break
@@ -183,7 +252,8 @@ class RaftGroups:
     def _build_submits(self) -> Submits:
         sub = self._empty_submits()
         if self._queues:
-            self._drain_into(self._queues, sub)
+            self._drain_into(self._queues, sub,
+                             skip=self._held or None)
         return sub
 
     # -- stepping ----------------------------------------------------------
@@ -211,6 +281,7 @@ class RaftGroups:
         self.metrics.counter("rounds").inc()
         if not explicit:
             self._requeue_rejected(submits, out)
+            self._record_assigned(submits, out)
         self._harvest(out)
         if self._query_queues:
             self._serve_queries()
@@ -283,15 +354,52 @@ class RaftGroups:
             else:
                 # escalate: re-enter as a command (quorum-committed read —
                 # always at least as strong as the requested level)
-                self._queues.setdefault(g, deque()).append(
-                    (int(sub.opcode[g, s]), int(sub.a[g, s]),
-                     int(sub.b[g, s]), int(sub.c[g, s]), tag))
+                op = (int(sub.opcode[g, s]), int(sub.a[g, s]),
+                      int(sub.b[g, s]), int(sub.c[g, s]))
+                self._queues.setdefault(g, deque()).append((*op, tag))
+                self._inflight_ops[tag] = op  # joins the loss-retry protocol
                 fell_back.inc()
+
+    def _record_assigned(self, submits: Submits, out: StepOutputs) -> None:
+        """Remember the (log index, term) each accepted queue-managed op
+        landed at (its current placement) for provable-loss retry — see
+        _harvest."""
+        acc = np.asarray(out.accepted)
+        if not acc.any():
+            return
+        idx = np.asarray(out.assigned)
+        trm = np.asarray(out.assigned_term)
+        for g, s in zip(*np.nonzero(acc)):
+            tag = int(submits.tag[g, s])
+            if tag in self._inflight_ops:
+                g = int(g)
+                old = self._tag_index.get(tag)
+                if old is not None:  # superseded placement (re-accept)
+                    self._drop_placement(old[0], old[1])
+                te = int(trm[g, s])
+                self._placements.setdefault(g, {})[int(idx[g, s])] = (tag, te)
+                self._tag_index[tag] = (g, int(idx[g, s]))
+                if te < self._pend_min.get(g, te + 1):
+                    self._pend_min[g] = te
 
     def _requeue_rejected(self, submits: Submits, out: StepOutputs) -> None:
         acc = np.asarray(out.accepted)
         valid = np.asarray(submits.valid)
-        rejected = valid & ~acc
+        refused = np.asarray(out.refused)
+        if refused.any():
+            # permanent rejection (e.g. a config change that would empty
+            # the group): fail to the client now — requeueing would block
+            # the group's queue forever behind the FIFO suffix-reject
+            failed = self.metrics.counter("ops_refused")
+            for g, s in zip(*np.nonzero(refused & valid)):
+                tag = int(submits.tag[g, s])
+                if tag in self._inflight:
+                    self._inflight.pop(tag)
+                    self._inflight_ops.pop(tag, None)
+                    from ..ops.apply import FAIL
+                    self.results[tag] = FAIL
+                    failed.inc()
+        rejected = valid & ~acc & ~refused
         if not rejected.any():
             return
         # appendleft in REVERSE slot order so retried ops keep submission order
@@ -303,16 +411,60 @@ class RaftGroups:
 
     def _harvest(self, out: StepOutputs) -> None:
         self.clock = int(np.asarray(out.clock).max(initial=self.clock))
+        lt = np.asarray(out.leader_term)
+        rose = self._placements and bool((lt > self._leader_term).any())
+        np.maximum(self._leader_term, lt, out=self._leader_term,
+                   casting="unsafe")
+        if rose:  # leader changes are rare; only then re-derive holds
+            for g, pend in self._placements.items():
+                if any(te < self._leader_term[g] for _, te in pend.values()):
+                    self._held.add(g)
         valid = np.asarray(out.out_valid)
         if valid.any():
             tags = np.asarray(out.out_tag)
             res = np.asarray(out.out_result)
+            index = np.asarray(out.out_index)
+            term = np.asarray(out.out_term)
             latency = self.metrics.histogram("commit_latency_rounds")
             committed = self.metrics.counter("ops_committed")
+            resubmitted = self.metrics.counter("ops_resubmitted")
             for g, i in zip(*np.nonzero(valid)):
+                g = int(g)
                 tag = int(tags[g, i])
+                j, T = int(index[g, i]), int(term[g, i])
+                pend = self._placements.get(g)
+                at_j = pend.get(j) if pend else None
+                if pend and ((at_j is not None and at_j[1] != T)
+                             or T > self._pend_min.get(g, T)):
+                    # provable loss: a pending placement (idx, term_e)
+                    # can never commit once (a) an entry with term
+                    # T > term_e applied at j <= idx — its log mismatches
+                    # the committed prefix at j — or (b) THIS index
+                    # applied under a different term (entries never move
+                    # between indices). Guarded by the _pend_min lower
+                    # bound so the steady state (T == every pending
+                    # term) skips the scan.
+                    lost = sorted(
+                        (idx, t) for idx, (t, te) in pend.items()
+                        if (idx >= j and te < T) or (idx == j and te != T))
+                    # appendleft in reverse idx order: co-lost ops keep
+                    # their original relative (log) order in the queue
+                    for idx, owner in reversed(lost):
+                        self._drop_placement(g, idx)
+                        self._tag_index.pop(owner, None)
+                        if owner in self._inflight:
+                            self._queues.setdefault(g, deque()).appendleft(
+                                (*self._inflight_ops[owner], owner))
+                            resubmitted.inc()
+                    pend = self._placements.get(g)
+                    if pend:  # refresh the stale lower bound
+                        self._pend_min[g] = min(te for _, te in pend.values())
                 if tag and tag in self._inflight:
                     _, submit_round = self._inflight.pop(tag)
+                    self._inflight_ops.pop(tag, None)
+                    placed = self._tag_index.pop(tag, None)
+                    if placed is not None:
+                        self._drop_placement(placed[0], placed[1])
                     self.results[tag] = int(res[g, i])
                     committed.inc()
                     latency.record(self.rounds - submit_round)
@@ -357,6 +509,45 @@ class RaftGroups:
             if (leaders >= 0).all():
                 return leaders
         raise TimeoutError(f"not all groups elected a leader in {max_rounds} rounds")
+
+    # -- cluster membership (server join/leave) ----------------------------
+
+    def add_peer(self, group: int, peer: int) -> int:
+        """Add ``peer``'s lane to ``group``'s voter set (the reference's
+        server join — ``AtomixServerTest.testServerJoin``). A single-server
+        Raft config change through the log: returns a correlation tag that
+        resolves in ``results`` once the entry is APPLIED (the step
+        serializes config changes — one in flight per group — by rejecting
+        early submits, which simply requeue here). Needs
+        ``Config(dynamic_membership=True)``."""
+        from ..ops.apply import OP_CFG_ADD
+        if not self.config.dynamic_membership:
+            raise ValueError("membership changes need "
+                             "Config(dynamic_membership=True)")
+        if not 0 <= peer < self.num_peers:
+            raise ValueError(f"peer {peer} outside 0..{self.num_peers - 1}")
+        return self.submit(group, OP_CFG_ADD, peer)
+
+    def remove_peer(self, group: int, peer: int) -> int:
+        """Remove ``peer``'s lane from ``group``'s voter set (server leave
+        — ``testServerLeave``). Removing the last member is refused: the
+        tag resolves to ``apply.FAIL``. A leader removing itself commits
+        the change under the old config and then steps down."""
+        from ..ops.apply import OP_CFG_REMOVE
+        if not self.config.dynamic_membership:
+            raise ValueError("membership changes need "
+                             "Config(dynamic_membership=True)")
+        if not 0 <= peer < self.num_peers:
+            raise ValueError(f"peer {peer} outside 0..{self.num_peers - 1}")
+        return self.submit(group, OP_CFG_REMOVE, peer)
+
+    def voting_members(self, group: int) -> list[int]:
+        """Current voter lanes of ``group``, read from the most-applied
+        lane's config bitmask (the freshest committed config)."""
+        member = np.asarray(self.state.member[group])      # [P] bitmasks
+        applied = np.asarray(self.state.applied_index[group])
+        mask = int(member[int(np.argmax(applied))])
+        return [p for p in range(self.num_peers) if (mask >> p) & 1]
 
     # -- inspection --------------------------------------------------------
 

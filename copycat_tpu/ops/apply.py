@@ -133,6 +133,19 @@ OP_TOPIC_UNLISTEN = 86    # a=subscriber id -> 1 if removed
 OP_TOPIC_PUB = 87         # a=message -> subscriber count at publish
 OP_TOPIC_COUNT = 88       # -> current subscriber count
 
+# Cluster membership change (consensus-layer, not a resource pool): a
+# single-server Raft configuration change rides the log like any command
+# and is applied by the consensus step itself — each replica lane updates
+# its OWN membership view when it applies the entry (``ops/consensus.py``
+# phase 5). Routed to POOL_NONE here (no resource work, result 0).
+# Reference obligation: server join/leave
+# (manager/src/test/java/io/atomix/AtomixServerTest.java
+# testServerJoin/testServerLeave); safety requires ONE change in flight
+# at a time (adjacent single-server configs always share a quorum
+# intersection), which the step enforces at append.
+OP_CFG_ADD = 90           # a=peer lane -> 0 (idempotent)
+OP_CFG_REMOVE = 91        # a=peer lane -> 0 (idempotent; last member kept)
+
 # Read-only opcodes servable on the fast query lane (query_step evaluates
 # and DISCARDS state, so admitting a write there would silently drop the
 # mutation while acking success — the host validates against this set).

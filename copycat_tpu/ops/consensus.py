@@ -44,6 +44,8 @@ import jax.numpy as jnp
 
 from .apply import (
     NUM_POOLS,
+    OP_CFG_ADD,
+    OP_CFG_REMOVE,
     ResourceConfig,
     ResourceState,
     _gather3,
@@ -91,6 +93,18 @@ class RaftState(NamedTuple):
     # which is exactly the freshness BOUNDED_LINEARIZABLE reads need
     # (reference Consistency.java:157-176) without a log append.
     lease: jnp.ndarray         # [G,P] bool (replicated per lane)
+    # Voting membership as of each lane's APPLIED prefix, a bitmask over
+    # peer lanes (bit p = lane p votes). Config entries carry the FULL
+    # new config (the leader composes the bitmask at append from its
+    # current view — Raft §4.1's C_new entries), and a lane's ACTIVE view
+    # is derived per round as the latest config entry in its log —
+    # adopted at append, reverted on truncation — falling back to this
+    # applied mask (Raft's "latest configuration in the log" rule; the
+    # applied prefix is immutable, so the fallback is always available).
+    # Single-server changes at a time (step-enforced at append) keep any
+    # two adjacent configs quorum-intersecting. All-ones unless
+    # ``Config.dynamic_membership`` — the static path never reads it.
+    member: jnp.ndarray        # [G,P] i32 bitmask
 
 
 class Submits(NamedTuple):
@@ -130,6 +144,33 @@ class StepOutputs(NamedTuple):
     ev_target: jnp.ndarray   # [G,D] i32
     ev_arg: jnp.ndarray      # [G,D] i32
     ev_valid: jnp.ndarray    # [G,D] bool
+    # (index, term) each accepted submit landed at / each applied entry
+    # came from. Together these give the host PROVABLE loss detection for
+    # exactly-once retry without any kernel dedup state (the device-path
+    # analogue of the reference's session-sequenced resubmit, Copycat
+    # client runtime per SURVEY §2.3): a pending entry (idx, term_e) is
+    # certainly lost once an entry with term T > term_e is applied at any
+    # index j ≤ idx — log terms are monotone within a log, so the log that
+    # held the pending entry had term ≤ term_e < T at j and can never be
+    # the committed log; re-submitting cannot double-apply. (idx == j with
+    # a different tag is the special case T != term_e of the same rule.)
+    assigned: jnp.ndarray       # [G,S] i32 (0 where not accepted)
+    assigned_term: jnp.ndarray  # [G,S] i32
+    out_index: jnp.ndarray      # [G,A] i32 (0 where not out_valid)
+    out_term: jnp.ndarray       # [G,A] i32
+    # POST-round leader term (-1 when leaderless): the host gates new
+    # submissions for a group while any accepted op's append term is
+    # older than this (the op's fate is uncertain across the leader
+    # change) — preserving per-group FIFO completion, the reference's
+    # session program-order guarantee. Post-round (not round-start) so
+    # the gate engages before anything can be drained into a fresh
+    # leader's log.
+    leader_term: jnp.ndarray    # [G] i32
+    # Submit slots rejected PERMANENTLY (a config change that would
+    # empty the group): the host fails them to the client immediately
+    # instead of requeueing — a forever-retrying config op would block
+    # its group's whole queue behind the FIFO suffix-reject.
+    refused: jnp.ndarray        # [G,S] bool
 
 
 class Config(NamedTuple):
@@ -152,14 +193,34 @@ class Config(NamedTuple):
     events_per_round: int = 4  # outbox events drained per step
     resource: ResourceConfig = ResourceConfig()
     use_pallas: bool = False  # Pallas quorum-tally kernel (TPU hot path)
+    # Per-group dynamic voter membership (server join/leave — reference
+    # AtomixServerTest.testServerJoin/testServerLeave). When True, quorum
+    # tallies count only each lane's ``RaftState.member`` view (dynamic
+    # per-group quorum via rank-select), non-member lanes neither
+    # campaign nor receive AppendEntries, and OP_CFG_ADD/REMOVE entries
+    # change membership at apply time. When False (default) the step
+    # compiles exactly as before — static P-lane quorum, member unread.
+    dynamic_membership: bool = False
 
 
 def init_state(num_groups: int, num_peers: int, log_slots: int,
-               key: jax.Array, config: Config = Config()) -> RaftState:
+               key: jax.Array, config: Config = Config(),
+               members=None) -> RaftState:
+    """``members`` (optional, needs ``config.dynamic_membership``): initial
+    voter set as a ``[P]`` or ``[G,P]`` bool mask — every lane starts with
+    the same view. Non-member lanes are cold standbys until an
+    ``OP_CFG_ADD`` entry brings them in (e.g. 3 voters in a P=5 tensor)."""
     G, P, L = num_groups, num_peers, log_slots
     z2 = jnp.zeros((G, P), jnp.int32)
     z3 = jnp.zeros((G, P, P), jnp.int32)
     zl = jnp.zeros((G, P, L), jnp.int32)
+    if members is None:
+        mem = jnp.full((G, P), (1 << P) - 1, jnp.int32)
+    else:
+        m = jnp.broadcast_to(jnp.asarray(members, bool), (G, P))
+        bits = jnp.sum(m * (1 << jnp.arange(P, dtype=jnp.int32))[None, :],
+                       axis=1, dtype=jnp.int32)
+        mem = jnp.broadcast_to(bits[:, None], (G, P))
     return RaftState(
         term=z2, voted_for=z2 - 1, role=z2 + FOLLOWER, leader_hint=z2 - 1,
         timer=jax.random.randint(key, (G, P), config.timer_min, config.timer_max),
@@ -170,6 +231,7 @@ def init_state(num_groups: int, num_peers: int, log_slots: int,
         log_time=zl, log_tag=zl,
         resources=init_resources(G, P, config.resource),
         lease=jnp.zeros((G, P), bool),
+        member=mem,
     )
 
 
@@ -273,6 +335,11 @@ def install_snapshots(state: RaftState, stale: jnp.ndarray,
         log_a=cp(state.log_a), log_b=cp(state.log_b), log_c=cp(state.log_c),
         log_time=cp(state.log_time), log_tag=cp(state.log_tag),
         resources=jax.tree.map(cp, state.resources),
+        # the applied-config mask is applied state like the pools: the
+        # stale lane adopts the leader's (its applied_index jumps with
+        # the snapshot; the log ring is copied too, so the derived
+        # latest-in-log view matches as well)
+        member=cp(state.member),
     )
 
 
@@ -372,6 +439,37 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
 
     lead, active = current_leader(state)
 
+    # Dynamic membership views (compiled in only when configured; the
+    # static path keeps the P-lane quorum and never reads state.member).
+    dyn = config.dynamic_membership
+    if dyn:
+        from .pallas_kernels import kth_largest_masked
+        # Each lane's ACTIVE config = the latest config entry in its log
+        # — adopted at APPEND, reverted by truncation (Raft §4.1) — else
+        # the applied-prefix mask. Entries in (applied, last] live at
+        # ring slot (idx-1) % L, so slot s holds index
+        # applied + 1 + ((s - applied) % L) when inside the window.
+        s_ids_m = jnp.arange(L, dtype=jnp.int32)[None, None, :]
+        off_m = (s_ids_m - state.applied_index[..., None]) % L
+        win_m = off_m < (state.last_index - state.applied_index)[..., None]
+        cfg_m = win_m & ((state.log_op == OP_CFG_ADD)
+                         | (state.log_op == OP_CFG_REMOVE))     # [G,P,L]
+        key_m = jnp.where(cfg_m, state.applied_index[..., None] + 1 + off_m,
+                          0)
+        best_m = jnp.max(key_m, axis=-1)                        # [G,P]
+        latest_mask = jnp.sum(
+            jnp.where(cfg_m & (key_m == best_m[..., None]), state.log_a, 0),
+            axis=-1)
+        view = jnp.where(best_m > 0, latest_mask, state.member)  # [G,P] i32
+        self_member = ((view >> peer_ids[None, :]) & 1).astype(bool)
+        view_quorum = jax.lax.population_count(view) // 2 + 1    # [G,P]
+        cfg_inflight = _peer_view(best_m > 0, lead)              # [G]
+        l_view = _peer_view(view, lead)                          # [G]
+        l_quorum = _peer_view(view_quorum, lead)                 # [G]
+        # which lanes the leader's active config counts
+        l_member = ((l_view[:, None] >> peer_ids[None, :]) & 1) \
+            .astype(bool)                                        # [G,P]
+
     l_term = _peer_view(state.term, lead)          # [G]
     l_last = _peer_view(state.last_index, lead)    # [G]
     l_commit = _peer_view(state.commit_index, lead)
@@ -398,10 +496,51 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
     # Backpressure: never let the ring overwrite entries the leader itself or
     # a quorum-th replica still has to apply (laggards beyond the window go
     # stale and are snapshot-installed by the host).
-    q_applied = _kth(state.applied_index, quorum)
+    # Under dynamic membership, quorum tallies count only the leader's
+    # member view — non-member lanes never receive entries, so an
+    # unmasked tally would wedge backpressure/commit at their floor.
+    if dyn:
+        q_applied = kth_largest_masked(state.applied_index, l_member,
+                                       l_quorum)
+    else:
+        q_applied = _kth(state.applied_index, quorum)
     allowed_last = jnp.minimum(l_applied, q_applied) + L
 
     valid = submits.valid & active[:, None]
+    if dyn:
+        # Config-change append guard + full-config composition: ONE
+        # change in flight at a time (adjacent single-server configs
+        # always quorum-intersect; two concurrent ones need not — Raft
+        # §4.2), so a config submit is rejected (the host requeues it)
+        # while a config entry sits un-applied in the leader's log or
+        # another rides earlier in the same window, and removing the
+        # last member is refused outright. The leader composes the FULL
+        # new config bitmask from its active view (Raft's C_new entries)
+        # — that mask, not the submitted lane, is what the entry's ``a``
+        # carries, so any lane can adopt a config from one entry.
+        is_cfg = (submits.opcode == OP_CFG_ADD) \
+            | (submits.opcode == OP_CFG_REMOVE)
+        in_range = (submits.a >= 0) & (submits.a < P)
+        bit = jnp.where(in_range, 1 << jnp.clip(submits.a, 0, P - 1), 0)
+        new_mask = jnp.where(submits.opcode == OP_CFG_ADD,
+                             l_view[:, None] | bit,
+                             l_view[:, None] & ~bit)            # [G,S]
+        first_cfg = (jnp.cumsum((is_cfg & valid).astype(jnp.int32),
+                                axis=1) == 1) & is_cfg
+        # Permanently impossible (would empty the group): FAIL fast via
+        # the refused output — requeueing would livelock the whole queue
+        # behind it (suffix rejects below keep FIFO hole-free).
+        refused = is_cfg & valid & first_cfg & ~cfg_inflight[:, None] \
+            & (new_mask == 0)
+        cfg_rejected = is_cfg & valid & ~(first_cfg & ~cfg_inflight[:, None]
+                                          & (new_mask != 0))
+        # Reject the whole window SUFFIX from a rejected config submit:
+        # rejections must stay hole-free (like backpressure's), or a
+        # later op in the same window would append — and commit — ahead
+        # of the requeued config change, breaking per-group FIFO
+        # completion (the session program order _harvest preserves).
+        valid = valid & (jnp.cumsum(cfg_rejected.astype(jnp.int32),
+                                    axis=1) == 0)
     pos = l_last[:, None] + jnp.cumsum(valid.astype(jnp.int32), axis=1)
     accepted = valid & (pos <= allowed_last[:, None])
     # One-hot scatter per log array: accepted slots are distinct within a
@@ -419,7 +558,9 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
     l_log_term = _inject(l_log_term,
                          jnp.broadcast_to(l_term[:, None], slot_s.shape))
     l_log_op = _inject(l_log_op, submits.opcode)
-    l_log_a = _inject(l_log_a, submits.a)
+    l_log_a = _inject(l_log_a,
+                      jnp.where(is_cfg, new_mask, submits.a) if dyn
+                      else submits.a)
     l_log_b = _inject(l_log_b, submits.b)
     l_log_c = _inject(l_log_c, submits.c)
     l_log_time = _inject(l_log_time,
@@ -431,6 +572,11 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
     del_fwd = _peer_view(deliver, lead)                       # deliver[g,lead,f]
     del_back = _peer_view(jnp.swapaxes(deliver, 1, 2), lead)  # deliver[g,f,lead]
     recv = active[:, None] & (peer_ids[None, :] != lead[:, None]) & del_fwd
+    if dyn:
+        # leaders replicate only to members of their current config; a
+        # re-added lane is behind and reconverges via rewind or the
+        # stale→snapshot-install path
+        recv = recv & l_member
 
     prev = l_next - 1                                         # [G,P]
     # The leader can only serve entries still in its ring: prev must sit
@@ -512,10 +658,15 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
     # Leader lease: a quorum of same-term acks THIS round (self included)
     # with no higher term observed — see RaftState.lease for why this
     # certifies exclusive leadership through this round.
-    acked = jnp.sum(ack_success | self_lane, axis=1)
-    lease_g = active & ~leader_stale & (acked >= quorum)
     match_full = jnp.where(self_lane, l_last[:, None], l_match)
-    cand_commit = _kth(match_full, quorum)
+    if dyn:
+        acked = jnp.sum((ack_success | self_lane) & l_member, axis=1)
+        lease_g = active & ~leader_stale & (acked >= l_quorum)
+        cand_commit = kth_largest_masked(match_full, l_member, l_quorum)
+    else:
+        acked = jnp.sum(ack_success | self_lane, axis=1)
+        lease_g = active & ~leader_stale & (acked >= quorum)
+        cand_commit = _kth(match_full, quorum)
     cand_commit_term = _term_at_2d(l_log_term, l_last, cand_commit[:, None])[:, 0]
     advance = active & ~leader_stale & (cand_commit > l_commit) \
         & (cand_commit_term == l_term)
@@ -543,8 +694,26 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
     key_t, key_c = jax.random.split(key)
     fresh = jax.random.randint(key_t, (G, P), config.timer_min, config.timer_max)
     is_ldr = role1 == LEADER
-    timer1 = jnp.where(heartbeat | is_ldr, fresh, state.timer - 1)
-    timeout = ~is_ldr & ~heartbeat & (timer1 <= 0)
+    # CheckQuorum (Raft thesis §6.2, the standard companion to leader
+    # stickiness below): a leader's timer is renewed only by an ack
+    # QUORUM this round (lease_g; stale lower-term leaders never renew).
+    # Without it, stickiness could wedge a group forever under a stable
+    # asymmetric partition — a leader reaching some-but-not-quorum
+    # followers keeps them sticky while never committing; here it steps
+    # down after an election timeout and its followers become electable.
+    renewed = self_lane & lease_g[:, None]
+    timer1 = jnp.where(heartbeat | (is_ldr & renewed), fresh,
+                       state.timer - 1)
+    ldr_down = is_ldr & (timer1 <= 0)
+    role1 = jnp.where(ldr_down, FOLLOWER, role1)
+    is_ldr = is_ldr & ~ldr_down
+    timer1 = jnp.where(ldr_down, fresh, timer1)
+    timeout = ~is_ldr & ~heartbeat & ~ldr_down & (timer1 <= 0)
+    if dyn:
+        # lanes outside their own config view never campaign (a removed
+        # server must not disrupt the cluster it left; a standby lane
+        # must not elect itself before an ADD brings it in)
+        timeout = timeout & self_member
 
     term_e = jnp.where(timeout, term1 + 1, term1)
     voted_e = jnp.where(timeout, peer_ids[None, :], voted1)
@@ -554,8 +723,18 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
                                     config.timer_max), timer1)
 
     cand_mask = role_e == CANDIDATE
-    # A vote needs request AND response delivery.
-    reach = cand_mask[:, :, None] & deliver & jnp.swapaxes(deliver, 1, 2)
+    # A vote needs request AND response delivery. Lanes that believe a
+    # current leader exists — they received its AppendEntries THIS round,
+    # or they ARE it — ignore RequestVote entirely (no term adoption, no
+    # grant): Raft's leader-stickiness rule (thesis §4.2.3), which is
+    # what stops a server that was removed from the config (and so
+    # receives no appends, is never deposed via the ack path, and cannot
+    # be caught up) from depose-looping a healthy group with ever-growing
+    # terms. A genuinely partitioned MEMBER still deposes a stale leader
+    # through its AppendEntries reject (leader_stale above), so real
+    # failovers are unaffected.
+    reach = cand_mask[:, :, None] & deliver & jnp.swapaxes(deliver, 1, 2) \
+        & ~(heartbeat | is_ldr)[:, None, :]
     c_term_b = jnp.where(reach, term_e[:, :, None], 0)
     v_seen = c_term_b.max(axis=1)                                 # [G,V]
     higher = v_seen > term_e
@@ -574,10 +753,21 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
     choice = jnp.where(elig, peer_ids[None, :, None], P).min(axis=1)  # [G,V]
     voted_v = jnp.where(choice < P, choice, voted_v)
     grant = elig & (peer_ids[None, :, None] == choice[:, None, :])
-    votes = grant.sum(axis=2)                                     # [G,C]
     # role_v is the post-vote role on the candidate's own lane (it may have
     # stepped down to a higher-term candidate).
-    won = (role_v == CANDIDATE) & cand_mask & (votes >= quorum)
+    if dyn:
+        # a candidate counts only votes from lanes in ITS active config
+        # view, against that view's quorum (any lane may still GRANT a
+        # vote — standard Raft: servers answer RequestVote from/for
+        # non-members for liveness during config changes)
+        mem_cv = ((view[:, :, None] >> peer_ids[None, None, :]) & 1) \
+            .astype(bool)                                         # [G,C,V]
+        votes = jnp.sum(grant & mem_cv, axis=2)                   # [G,C]
+        won = (role_v == CANDIDATE) & cand_mask & self_member \
+            & (votes >= view_quorum)
+    else:
+        votes = grant.sum(axis=2)                                 # [G,C]
+        won = (role_v == CANDIDATE) & cand_mask & (votes >= quorum)
 
     role_f = jnp.where(won, LEADER, role_v)
     hint_f = jnp.where(won, peer_ids[None, :], hint1)
@@ -607,6 +797,10 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
     win_oh = slot_all[..., None] == jnp.arange(L, dtype=jnp.int32)  # [G,P,A,L]
     ga = lambda log: jnp.where(win_oh, log[:, :, None, :], 0).sum(axis=-1)
     time_w = ga(log_time2)
+    op_w = ga(log_op2)
+    a_w = ga(log_a2)
+    b_w = ga(log_b2)
+    c_w = ga(log_c2)
     if config.pool_budgets is not None:
         if len(config.pool_budgets) != NUM_POOLS:
             raise ValueError(
@@ -616,8 +810,8 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
         budgets = tuple(max(1, min(int(x), A))
                         for x in config.pool_budgets)
         resources, res_w, admitted = apply_window(
-            state.resources, ga(log_op2), ga(log_a2), ga(log_b2),
-            ga(log_c2), idx_all, time_w, do_all, budgets)
+            state.resources, op_w, a_w, b_w, c_w, idx_all, time_w,
+            do_all, budgets)
     else:
         # No budgets → every entry in the window applies; the single
         # sequential scan over the composed kernel has fewer fusions than
@@ -626,8 +820,7 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
         # when budgets shrink a heavy pool's HBM traffic (mixed configs).
         xs = jax.tree.map(
             lambda x: jnp.moveaxis(x, 2, 0),                  # [A,G,P]
-            (ga(log_op2), ga(log_a2), ga(log_b2), ga(log_c2),
-             time_w, idx_all, do_all))
+            (op_w, a_w, b_w, c_w, time_w, idx_all, do_all))
 
         def _apply_one(resources, x):
             op_i, a_i, b_i, c_i, time_i, idx, do = x
@@ -639,6 +832,21 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
         admitted = do_all
     applied = state.applied_index \
         + admitted.sum(axis=-1, dtype=jnp.int32)
+
+    # Config-change entries take effect on each lane AS IT APPLIES them:
+    # an unrolled in-order fold over the ≤A window positions (config
+    # changes are rare, so A tiny [G,P] selects per round are noise; the
+    # one-in-flight append guard means ≥2 hits per window only when a
+    # lane catches up on two serialized changes at once — the fold order
+    # keeps even that correct).
+    member2 = state.member
+    if dyn:
+        # config entries carry the full bitmask, so the applied config is
+        # just the mask of the latest admitted config entry in the window
+        cfg_w = (op_w == OP_CFG_ADD) | (op_w == OP_CFG_REMOVE)
+        for i in range(A):
+            hit = admitted[:, :, i] & cfg_w[:, :, i]              # [G,P]
+            member2 = jnp.where(hit, a_w[:, :, i], member2)
 
     # Reporting lane: the lane with the highest applied_index AFTER this
     # round. In the first round the global max passes an entry, the argmax
@@ -661,6 +869,24 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
         resources, config.events_per_round, active)
     lead_ev = active[:, None] & _peer_view(ev_ok, lead)
 
+    if dyn:
+        # A leader whose removal has been committed+applied steps down
+        # (Raft thesis §4.2.2: it keeps leading while C_new-without-self
+        # replicates, under the old config, then stops). Candidates are
+        # judged by the ACTIVE view instead — a re-added lane may
+        # campaign on its appended-but-uncommitted config, a removed
+        # lane's view reverts to the applied mask and it stands down.
+        self_m2 = ((member2 >> peer_ids[None, :]) & 1).astype(bool)
+        # Step down only when BOTH the applied config and the active
+        # view exclude the lane: a lane that won its election on an
+        # appended-but-uncommitted re-ADD (view includes it, applied
+        # does not) must keep leading until that entry applies, or it
+        # would be demoted every round and churn terms forever.
+        role_f = jnp.where((role_f == LEADER) & ~self_m2 & ~self_member,
+                           FOLLOWER, role_f)
+        role_f = jnp.where((role_f == CANDIDATE) & ~self_member, FOLLOWER,
+                           role_f)
+
     new_state = RaftState(
         term=jnp.maximum(term_v, term_e), voted_for=voted_v, role=role_f,
         leader_hint=hint_f, timer=timer1, clock=clock1,
@@ -669,7 +895,8 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
         log_term=log_term2, log_op=log_op2, log_a=log_a2, log_b=log_b2,
         log_c=log_c2, log_time=log_time2,
         log_tag=log_tag2, resources=resources,
-        lease=jnp.broadcast_to(lease_g[:, None], (G, P)))
+        lease=jnp.broadcast_to(lease_g[:, None], (G, P)),
+        member=member2)
     outputs = StepOutputs(
         accepted=accepted, out_valid=out_valid, out_tag=out_tag,
         out_result=out_result, out_latency=out_latency, leader=lead,
@@ -677,5 +904,12 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
         stale=stale, clock=l_clock,
         ev_seq=_peer_view(ev_seq, lead), ev_code=_peer_view(ev_code, lead),
         ev_target=_peer_view(ev_target, lead),
-        ev_arg=_peer_view(ev_arg, lead), ev_valid=lead_ev)
+        ev_arg=_peer_view(ev_arg, lead), ev_valid=lead_ev,
+        assigned=jnp.where(accepted, pos, 0),
+        assigned_term=jnp.where(accepted, l_term[:, None], 0),
+        out_index=jnp.where(out_valid, rep3(idx_all), 0),
+        out_term=jnp.where(out_valid, rep3(ga(log_term2)), 0),
+        leader_term=jnp.max(
+            jnp.where(role_f == LEADER, new_state.term, -1), axis=1),
+        refused=refused if dyn else jnp.zeros_like(submits.valid))
     return new_state, outputs

@@ -41,6 +41,31 @@ def kth_largest(x: jnp.ndarray, k: int) -> jnp.ndarray:
     return jnp.max(m, axis=1)
 
 
+def kth_largest_masked(x: jnp.ndarray, mask: jnp.ndarray,
+                       k: jnp.ndarray) -> jnp.ndarray:
+    """k-th largest of ``x [G, P]`` among ``mask [G, P]`` lanes, with a
+    PER-GROUP dynamic ``k [G]`` (1-based).
+
+    The dynamic-membership quorum tally: masked-out (non-member) lanes are
+    excluded, and k varies per group (``count//2 + 1`` of each group's
+    member count). Static-k masked max-extraction can't express a traced
+    k, so this uses the same O(P²) pairwise rank-select as the Pallas
+    kernel — each element's tie-broken descending rank is unique, and
+    exactly one element matches rank k-1 (provided k ≤ member count,
+    which quorum-of-members guarantees).
+    """
+    P = x.shape[1]
+    xm = jnp.where(mask, x, INT_MIN)
+    r_val = xm[:, :, None]                    # element r   [G,P,1]
+    s_val = xm[:, None, :]                    # vs s        [G,1,P]
+    r_idx = jnp.arange(P, dtype=jnp.int32)[None, :, None]
+    s_idx = jnp.arange(P, dtype=jnp.int32)[None, None, :]
+    beats = (s_val > r_val) | ((s_val == r_val) & (s_idx < r_idx))
+    rank = jnp.sum(beats.astype(jnp.int32), axis=2)          # [G,P]
+    sel = rank == (k - 1)[:, None]
+    return jnp.sum(jnp.where(sel, xm, 0), axis=1)
+
+
 def _kth_kernel(x_ref, out_ref, *, k: int):
     """Block kernel: x [P, BG] -> out [1, BG] (k-th largest over axis 0).
 

@@ -101,7 +101,7 @@ def test_load_snapshot_missing_newer_pool_leaves(tmp_path):
 
     # (a) path-keyed format with newer fields missing entirely
     partial = {k: v for k, v in arrays.items()
-               if not any(f in k for f in ("mm_", "tp_", "lease"))}
+               if not any(f in k for f in ("mm_", "tp_", "lease", "member"))}
     old_pk = tmp_path / "path-keyed-old.npz"
     np.savez_compressed(str(old_pk), meta=json.dumps(meta), **partial)
     restored = checkpoint.load(old_pk)
@@ -117,7 +117,7 @@ def test_load_snapshot_missing_newer_pool_leaves(tmp_path):
     for path_keys, leaf in flat:
         name = "state." + ".".join(
             getattr(pk, "name", str(pk)) for pk in path_keys)
-        if any(f in name for f in ("mm_", "tp_", "lease")):
+        if any(f in name for f in ("mm_", "tp_", "lease", "member")):
             continue
         legacy[f"leaf_{n}"] = arrays[name]
         n += 1

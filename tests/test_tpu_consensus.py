@@ -121,6 +121,30 @@ def test_leader_partition_failover_preserves_committed_writes():
     assert (val[0] == 10).all()
 
 
+def test_checkquorum_releases_asymmetric_partition():
+    """Stable ASYMMETRIC partition: the leader's outbound links to two of
+    its three followers are cut, everything else stays up. The reachable
+    follower is kept sticky by heartbeats (it refuses RequestVote —
+    leader stickiness), so without CheckQuorum the group would wedge
+    forever at 2 < 3 acks. CheckQuorum steps the quorumless leader down
+    after an election timeout, heartbeats stop, and the fully-connected
+    majority elects a working leader."""
+    rg = make(groups=1, peers=4, log_slots=32)
+    rg.wait_for_leaders()
+    lead = rg.leader(0)
+    others = [p for p in range(4) if p != lead]
+    dl = np.ones((1, 4, 4), bool)
+    dl[0, lead, others[1]] = False
+    dl[0, lead, others[2]] = False
+    tag = rg.submit(0, ap.OP_LONG_ADD, 5)
+    for _ in range(80):
+        rg.step_round(deliver=jnp.asarray(dl))
+        if tag in rg.results:
+            break
+    assert rg.results.get(tag) == 5, \
+        "group wedged under asymmetric partition (CheckQuorum inactive?)"
+
+
 def test_safety_under_random_partitions():
     G, P = 4, 3
     rg = make(groups=G, peers=P, log_slots=64,
