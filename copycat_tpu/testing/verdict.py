@@ -11,7 +11,10 @@ and every sampled history is checked. Output: one JSON line on stdout +
 ``LINEARIZABILITY.md`` rewritten with the verdict.
 
 Run: ``python -m copycat_tpu.testing.verdict`` (env overrides:
-``COPYCAT_VERDICT_GROUPS/SAMPLE/ROUNDS/SEED``).
+``COPYCAT_VERDICT_GROUPS/SAMPLE/ROUNDS/SEED``, plus
+``COPYCAT_VERDICT_CHURN=0`` to disable the default membership churn —
+with churn on, groups run 5 peer lanes with 3 initial voters and server
+join/leave cycles through the voter sets mid-faults).
 """
 
 from __future__ import annotations
@@ -34,6 +37,15 @@ SAMPLE = int(os.environ.get("COPYCAT_VERDICT_SAMPLE", "99"))
 ROUNDS = int(os.environ.get("COPYCAT_VERDICT_ROUNDS", "400"))
 SEED = int(os.environ.get("COPYCAT_VERDICT_SEED", "42"))
 BACKGROUND_PER_ROUND = 500  # untracked load spread over the other groups
+# Membership churn (default ON): groups run 5 peer lanes with 3 initial
+# voters and the nemesis is joined by server join/leave — every sampled
+# group cycles lanes 3/4 in and out of its voter set while its history
+# is recorded. Jepsen's hardest configuration for the reference is
+# exactly faults + membership changes together; linearizability of
+# client ops must hold across config changes.
+CHURN = os.environ.get("COPYCAT_VERDICT_CHURN", "1") == "1"
+CHURN_PERIOD = 20
+CHURN_CYCLE = (("add", 3), ("add", 4), ("remove", 3), ("remove", 4))
 
 
 def _log(msg: str) -> None:
@@ -83,7 +95,12 @@ def _invoke_lock(rec: HistoryRecorder, g: int, rng) -> None:
 
 def run_verdict() -> dict:
     t0 = time.time()
-    rg = RaftGroups(GROUPS, 3, log_slots=64, submit_slots=4, seed=SEED)
+    if CHURN:
+        from ..ops.consensus import Config
+        rg = RaftGroups(GROUPS, 5, log_slots=64, submit_slots=4, seed=SEED,
+                        config=Config(dynamic_membership=True), voters=3)
+    else:
+        rg = RaftGroups(GROUPS, 3, log_slots=64, submit_slots=4, seed=SEED)
     rg.wait_for_leaders()
     rec = HistoryRecorder(rg)
     nemesis = Nemesis(rg, seed=SEED + 1, period=12)
@@ -100,8 +117,25 @@ def run_verdict() -> dict:
     _log(f"verdict: G={GROUPS} sample={SAMPLE} rounds={ROUNDS} "
          f"nemesis period=12 device load={BACKGROUND_PER_ROUND}/round")
     bg_tags: set[int] = set()
+    cfg_tags: set[int] = set()
+    cfg_submitted = cfg_applied = 0
+    churn_step = 0
     for round_no in range(ROUNDS):
         nemesis.tick()
+        if CHURN and round_no % CHURN_PERIOD == CHURN_PERIOD // 2:
+            # server join/leave on every sampled group (and a slice of
+            # the background) while their histories are recorded; the
+            # kernel serializes per group, the host requeues early ones
+            kind, lane = CHURN_CYCLE[churn_step % len(CHURN_CYCLE)]
+            churn_step += 1
+            targets = [int(g) for g in sampled]
+            targets += [int(g) for g in
+                        rng.choice(others, size=min(200, len(others)),
+                                   replace=False)]
+            for g in targets:
+                cfg_tags.add(rg.add_peer(g, lane) if kind == "add"
+                             else rg.remove_peer(g, lane))
+                cfg_submitted += 1
         # recorded client ops: one per sampled group every 4 rounds
         if round_no % 4 == 0:
             for g in reg_groups:
@@ -117,6 +151,11 @@ def run_verdict() -> dict:
             bg_tags.add(rg.submit(int(g), ap.OP_LONG_ADD, 1))
         rec.tick()
         bg_tags = {t for t in bg_tags if rg.results.pop(t, None) is None}
+        done_cfg = {t for t in cfg_tags if t in rg.results}
+        cfg_applied += len(done_cfg)
+        for t in done_cfg:
+            rg.results.pop(t)
+        cfg_tags -= done_cfg
         if round_no % 50 == 49:
             _log(f"verdict: round {round_no + 1}/{ROUNDS} "
                  f"fault={nemesis.current} pending={len(rec._pending)}")
@@ -147,17 +186,29 @@ def run_verdict() -> dict:
         "sampled_groups": checked,
         "checked_ops": total_ops,
         "rounds": ROUNDS,
-        "nemesis": "partition/isolate/loss, period 12",
+        "nemesis": "partition/isolate/loss, period 12"
+                   + (", membership churn" if CHURN else ""),
         "violations": failures,
         "search_nodes": total_nodes,
         "incomplete_ops": len(rec._pending),
         "wall_s": round(time.time() - t0, 1),
         "seed": SEED,
     }
+    if CHURN:
+        result["membership_changes_applied"] = cfg_applied
+        result["membership_changes_submitted"] = cfg_submitted
     return result
 
 
 def _write_artifact(result: dict) -> None:
+    churn_clause = ""
+    if "membership_changes_applied" in result:
+        churn_clause = (
+            " WITH live membership churn (server join/leave cycling"
+            " lanes 3/4 of every sampled group's voter set — Jepsen's"
+            " hardest configuration:"
+            f" {result['membership_changes_applied']:,} config changes"
+            " applied mid-faults)")
     lines = [
         "# LINEARIZABILITY — verdict artifact at bench scale",
         "",
@@ -169,7 +220,8 @@ def _write_artifact(result: dict) -> None:
         f" {result['groups']:,}-group device",
         "batch ran under a randomized nemesis (partitions, single-peer"
         " isolation,",
-        "30% message loss; period 12 rounds) with client load;"
+        "30% message loss; period 12 rounds)" + churn_clause
+        + " with client load;"
         f" {result['sampled_groups']}",
         "sampled groups recorded real-time histories across three"
         " resource models",
