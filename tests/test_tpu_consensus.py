@@ -121,6 +121,28 @@ def test_leader_partition_failover_preserves_committed_writes():
     assert (val[0] == 10).all()
 
 
+def test_submit_batch_matches_scalar_submits():
+    """The vectorized bulk-submit path must be behaviorally identical to
+    per-op submits: same per-group FIFO order, same results, tags
+    aligned with the input."""
+    rg = make(groups=4, peers=3)
+    rg.wait_for_leaders()
+    groups = np.array([0, 0, 1, 2, 3, 3, 3])
+    deltas = np.array([1, 2, 10, 5, 7, 1, 2])
+    tags = rg.submit_batch(groups, ap.OP_LONG_ADD, deltas)
+    assert tags.shape == (7,)
+    rg.run_until(tags.tolist())
+    # prefix sums per group prove FIFO within each group
+    assert [rg.results[t] for t in tags.tolist()] == [1, 3, 10, 5, 7, 8, 10]
+    # interleaves with scalar submits
+    t = rg.submit(0, ap.OP_LONG_ADD, 4)
+    more = rg.submit_batch([0], ap.OP_LONG_ADD, [5])
+    rg.run_until([t, int(more[0])])
+    assert rg.results[t] == 7 and rg.results[int(more[0])] == 12
+    with pytest.raises(ValueError):
+        rg.submit_batch([0], ap.OP_CFG_ADD, [1])
+
+
 def test_checkquorum_releases_asymmetric_partition():
     """Stable ASYMMETRIC partition: the leader's outbound links to two of
     its three followers are cut, everything else stays up. The reachable
