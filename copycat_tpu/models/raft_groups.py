@@ -277,6 +277,34 @@ class RaftGroups:
 
     # -- stepping ----------------------------------------------------------
 
+    # Hooks the multi-host driver overrides (parallel/multihost.py): the
+    # base engine stages host numpy straight onto the device and fetches
+    # whole output arrays; a multi-process driver assembles GLOBAL arrays
+    # from each process's local block and fetches only addressable shards.
+    _always_serve_queries = False
+
+    def _stage_submits(self, submits: Submits) -> Submits:
+        return submits
+
+    def _stage_deliver(self, deliver: Any) -> Any:
+        return deliver
+
+    def _fetch_outputs(self, raw: StepOutputs) -> StepOutputs:
+        # ONE overlapped device->host transfer for all output arrays: the
+        # lazy per-array np.asarray calls in the harvest each paid a full
+        # transfer round-trip (67 ms/array through a tunneled device —
+        # it dominated the host loop at 10k groups).
+        for leaf in jax.tree.leaves(raw):
+            leaf.copy_to_host_async()
+        return jax.tree.map(np.asarray, raw)
+
+    def _stale_any(self, raw: StepOutputs, out: StepOutputs) -> bool:
+        return bool(out.stale.any())
+
+    def _run_query(self, sub: Submits, atomic) -> tuple[Any, Any]:
+        results, served = self._query(self.state, sub, atomic)
+        return np.asarray(results), np.asarray(served)
+
     def step_round(self, submits: Submits | None = None,
                    deliver: Any | None = None) -> StepOutputs:
         """Advance every group one round; harvests results into ``results``."""
@@ -284,18 +312,12 @@ class RaftGroups:
         if submits is None:
             submits = self._build_submits()
         self._key, key = jax.random.split(self._key)
+        dl = self.deliver if deliver is None else self._stage_deliver(deliver)
         with self.metrics.timer("step_wall_ms"):
-            self.state, out = self._step(
-                self.state, submits,
-                self.deliver if deliver is None else deliver, key)
-            out = jax.block_until_ready(out)  # time compute, not dispatch
-        # ONE overlapped device->host transfer for all output arrays: the
-        # lazy per-array np.asarray calls in the harvest each paid a full
-        # transfer round-trip (67 ms/array through a tunneled device —
-        # it dominated the host loop at 10k groups).
-        for leaf in jax.tree.leaves(out):
-            leaf.copy_to_host_async()
-        out = jax.tree.map(np.asarray, out)
+            self.state, raw = self._step(
+                self.state, self._stage_submits(submits), dl, key)
+            raw = jax.block_until_ready(raw)  # time compute, not dispatch
+        out = self._fetch_outputs(raw)
         self.rounds += 1
         self.metrics.counter("rounds").inc()
         if not explicit:
@@ -310,13 +332,13 @@ class RaftGroups:
         # leader's within its own round).
         if not explicit:
             self._record_assigned(submits, out)
-        if self._query_queues:
+        if self._query_queues or self._always_serve_queries:
             self._serve_queries()
         # Followers lagging beyond the ring window can't be served by
         # AppendEntries: install a snapshot of the leader's lane (log ring +
         # applied resource state) so they reconverge.
-        if bool(np.asarray(out.stale).any()):
-            self.state = self._install(self.state, out.stale, out.leader)
+        if self._stale_any(raw, out):
+            self.state = self._install(self.state, raw.stale, raw.leader)
         if self._sessions is not None:
             self._sessions.tick()
         return out
@@ -347,10 +369,10 @@ class RaftGroups:
         atomic = np.zeros_like(sub.valid)
         atomic[group, 0] = consistency == "atomic"
         for _ in range(max_attempts):
-            results, served = self._query(self.state, sub, atomic)
-            if bool(np.asarray(served)[group, 0]):
+            results, served = self._run_query(sub, atomic)
+            if bool(served[group, 0]):
                 self.metrics.counter("queries_served").inc()
-                return int(np.asarray(results)[group, 0])
+                return int(results[group, 0])
             self.step_round()  # no leader yet / applied < commit: settle
         raise TimeoutError(
             f"group {group} query unservable after {max_attempts} rounds")
@@ -365,9 +387,7 @@ class RaftGroups:
         for g, s in placed:
             if int(sub.tag[g, s]) in self._query_atomic:
                 atomic[g, s] = True
-        results, served = self._query(self.state, sub, atomic)
-        results = np.asarray(results)
-        served = np.asarray(served)
+        results, served = self._run_query(sub, atomic)
         fell_back = self.metrics.counter("queries_escalated")
         done = self.metrics.counter("queries_served")
         for g, s in placed:

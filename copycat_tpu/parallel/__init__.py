@@ -12,3 +12,4 @@ Two scaling axes (SURVEY.md §2.2):
 """
 
 from .mesh import make_mesh, raft_specs, shard_state, shard_step_inputs  # noqa: F401
+from . import multihost  # noqa: F401  (multi-process: one SPMD step over DCN)

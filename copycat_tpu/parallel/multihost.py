@@ -1,0 +1,259 @@
+"""Multi-host (multi-process) execution of the batched consensus engine.
+
+The reference scales by running one server process per machine over
+Netty/TCP (SURVEY.md §5.8). The TPU-native equivalent: ONE SPMD program
+over a global ``jax.sharding.Mesh`` spanning every process's devices —
+`jax.distributed` wires the processes (gRPC coordination over DCN), XLA
+inserts the cross-process collectives for the peer-axis tallies, and
+each process keeps the CLIENT side (queues, harvest, sessions, retry
+protocol) for the groups whose shards it hosts. Client traffic is
+host-local; replica traffic is ICI/DCN inside the compiled step —
+exactly the split SURVEY.md §5.8 prescribes.
+
+Usage (same program on every process — SPMD):
+
+    from copycat_tpu.parallel import multihost
+    multihost.initialize("host0:9100", num_processes=4, process_id=i)
+    rg = multihost.MultiHostRaftGroups(groups_per_process=2500)
+    rg.wait_for_leaders()            # lockstep-coordinated
+    tag = rg.submit(local_group, OP_LONG_ADD, 1)   # local group index
+    rg.run_until([tag])              # lockstep-coordinated
+
+LOCKSTEP CONTRACT: ``step_round`` launches a collective program, so all
+processes must call it the same number of times. The coordination-aware
+methods here (`run_until`, `wait_for_leaders`) agree globally before
+stopping; anything else that steps conditionally must be driven
+symmetrically on every process. Verified end-to-end by
+``tests/test_multihost.py`` (two real processes over a loopback
+coordinator on the CPU backend).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from functools import partial
+
+from ..models.raft_groups import RaftGroups
+from ..ops.consensus import (
+    Config,
+    Submits,
+    init_state,
+    install_snapshots,
+    query_step,
+    step,
+)
+from .mesh import raft_specs
+
+
+def initialize(coordinator_address: str, num_processes: int,
+               process_id: int, platform: str | None = None) -> None:
+    """Wire this process into the cluster (``jax.distributed``). Call
+    before any other JAX use; every process must call it with the same
+    coordinator (process 0's address)."""
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    jax.distributed.initialize(coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def global_mesh() -> Mesh:
+    """1D ``('groups',)`` mesh over ALL processes' devices, ordered so
+    each process's devices form one contiguous block of the group axis
+    (jax.devices() orders by process index)."""
+    return Mesh(np.asarray(jax.devices()), ("groups",))
+
+
+class MultiHostRaftGroups(RaftGroups):
+    """``RaftGroups`` over a process-spanning mesh: the consensus state
+    is ONE global sharded pytree, the step is one collective XLA
+    program, and THIS process's host runtime (submit queues, harvest,
+    results, events, sessions, exactly-once retry) covers the
+    ``groups_per_process`` groups whose shards live on its devices.
+    Group indices in the public API are process-LOCAL (0..Gp-1); the
+    global group id is ``group + group_offset``."""
+
+    _always_serve_queries = True  # query program must run in lockstep
+
+    def __init__(self, groups_per_process: int, num_peers: int = 3,
+                 log_slots: int = 64, submit_slots: int = 4,
+                 config: Config | None = None, seed: int = 0,
+                 voters: int | None = None) -> None:
+        if jax.process_count() < 2:
+            raise RuntimeError(
+                "MultiHostRaftGroups needs jax.distributed to be "
+                "initialized across >=2 processes (multihost.initialize)")
+        self.process_index = jax.process_index()
+        self.process_count = jax.process_count()
+        self.global_groups = groups_per_process * self.process_count
+        self.group_offset = groups_per_process * self.process_index
+        # Base init sizes ALL host bookkeeping to the local block (its
+        # num_groups) and compiles the shared jit wrappers; its locally
+        # shaped state/deliver are replaced with global sharded ones.
+        super().__init__(groups_per_process, num_peers, log_slots,
+                         submit_slots, config, seed, voters=voters)
+        self.mesh = global_mesh()
+        self._sub_sharding = NamedSharding(self.mesh, P("groups", None))
+        self._dl_sharding = NamedSharding(self.mesh, P("groups", None, None))
+
+        # Global replicated-construction state: every process builds the
+        # SAME full-size host arrays (same seed -> identical), then each
+        # contributes only the shards its devices own.
+        key = jax.random.PRNGKey(seed)
+        _, init_key = jax.random.split(key)
+        members = None
+        if voters is not None and voters < num_peers:
+            members = np.arange(num_peers) < voters
+        full = init_state(self.global_groups, num_peers, log_slots,
+                          init_key, self.config, members=members)
+        specs = raft_specs(self.mesh, full)
+        is_spec = lambda x: isinstance(x, P)
+        state_sh = jax.tree.map(lambda s: NamedSharding(self.mesh, s),
+                                specs, is_leaf=is_spec)
+        self.state = jax.tree.map(
+            lambda x, s: jax.make_array_from_callback(
+                x.shape, s, lambda idx, x=x: np.asarray(x)[idx]),
+            full, state_sh)
+        self.deliver = self._stage_deliver(
+            np.ones((groups_per_process, num_peers, num_peers), bool))
+        # Output shardings are PINNED to group-sharded (leading dim on
+        # the mesh, rest replicated): the shard-concat fetch below relies
+        # on every output leaf being split by groups, and without the pin
+        # the compiler is free to replicate an output.
+        out_sh = NamedSharding(self.mesh, P("groups"))
+        self._step = jax.jit(partial(step, config=self.config),
+                             out_shardings=(state_sh, out_sh))
+        self._query = jax.jit(partial(query_step, config=self.config),
+                              out_shardings=out_sh)
+        self._install = jax.jit(partial(install_snapshots,
+                                        config=self.config),
+                                out_shardings=state_sh)
+        self._global_any = jax.jit(jnp.any)
+
+    # -- staging/fetch hooks: local block <-> global sharded arrays ------
+
+    def _stage_submits(self, submits: Submits) -> Submits:
+        return Submits(*[
+            jax.make_array_from_process_local_data(
+                self._sub_sharding, np.ascontiguousarray(x))
+            for x in submits])
+
+    def _stage_deliver(self, deliver: Any) -> Any:
+        return jax.make_array_from_process_local_data(
+            self._dl_sharding, np.ascontiguousarray(np.asarray(deliver)))
+
+    @staticmethod
+    def _local_block(x) -> np.ndarray:
+        """This process's contiguous block of a group-sharded global
+        array (shards ordered by their group-axis offset)."""
+        shards = sorted(x.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
+    def _fetch_outputs(self, raw):
+        # overlap the D2H transfers (same rationale as the base hook:
+        # lazy per-array fetches each pay a full round-trip), then
+        # assemble each leaf's local block
+        for leaf in jax.tree.leaves(raw):
+            for s in leaf.addressable_shards:
+                s.data.copy_to_host_async()
+        return jax.tree.map(self._local_block, raw)
+
+    def _stale_any(self, raw, out) -> bool:
+        # the install decision must be GLOBALLY consistent (install runs
+        # a collective program): reduce over the global array — the
+        # replicated scalar is addressable on every process
+        return bool(np.asarray(self._global_any(raw.stale)))
+
+    def _run_query(self, sub: Submits, atomic):
+        g_atomic = jax.make_array_from_process_local_data(
+            self._sub_sharding, np.ascontiguousarray(atomic))
+        results, served = self._query(self.state, self._stage_submits(sub),
+                                      g_atomic)
+        return self._local_block(results), self._local_block(served)
+
+    # -- lockstep-coordinated drivers ------------------------------------
+
+    def _all_processes(self, mine: bool) -> bool:
+        from jax.experimental import multihost_utils
+        flags = multihost_utils.process_allgather(
+            np.asarray(mine, dtype=bool))
+        return bool(np.asarray(flags).all())
+
+    def run_until(self, tags: list[int], max_rounds: int = 200) -> None:
+        """Step in lockstep until every process has results for ITS
+        tags (each process passes its own list; pass [] if idle)."""
+        for _ in range(max_rounds):
+            if self._all_processes(all(t in self.results for t in tags)):
+                return
+            self.step_round()
+        missing = [t for t in tags if t not in self.results]
+        raise TimeoutError(
+            f"ops not committed after {max_rounds} rounds: {missing}")
+
+    def wait_for_leaders(self, max_rounds: int = 100) -> np.ndarray:
+        """Step in lockstep until every process's local groups all have
+        leaders; returns this process's local leader indices."""
+        leaders = None
+        for _ in range(max_rounds):
+            out = self.step_round()
+            leaders = np.asarray(out.leader)
+            if self._all_processes(bool((leaders >= 0).all())):
+                return leaders
+        raise TimeoutError(
+            f"not all groups elected a leader in {max_rounds} rounds")
+
+    def serve_query(self, group: int, opcode: int, a: int = 0, b: int = 0,
+                    c: int = 0, max_attempts: int = 50,
+                    consistency: str = "sequential") -> int:
+        """Lockstep variant of the ad-hoc read: EVERY process must call
+        this symmetrically (its own group/op); all keep evaluating the
+        query program — and stepping when anyone is unserved — until
+        every process's read is served."""
+        from ..ops.apply import QUERY_OPCODES
+        if opcode not in QUERY_OPCODES:
+            raise ValueError(
+                f"opcode {opcode} is not read-only; submit it as a command")
+        sub = self._empty_submits()
+        sub.opcode[group, 0] = opcode
+        sub.a[group, 0] = a
+        sub.b[group, 0] = b
+        sub.c[group, 0] = c
+        sub.valid[group, 0] = True
+        atomic = np.zeros_like(sub.valid)
+        atomic[group, 0] = consistency == "atomic"
+        for _ in range(max_attempts):
+            results, served = self._run_query(sub, atomic)
+            if self._all_processes(bool(served[group, 0])):
+                self.metrics.counter("queries_served").inc()
+                return int(results[group, 0])
+            self.step_round()
+        raise TimeoutError(
+            f"group {group} query unservable after {max_attempts} rounds")
+
+    # -- local views -------------------------------------------------------
+
+    def leader(self, group: int) -> int:
+        """Leader lane of LOCAL ``group`` (reads this process's shard)."""
+        role = self._local_block(self.state.role)[group]
+        term = self._local_block(self.state.term)[group]
+        leaders = np.nonzero(role == 2)[0]
+        if len(leaders) == 0:
+            return -1
+        return int(leaders[np.argmax(term[leaders])])
+
+    def value(self, group: int, peer: int = 0) -> int:
+        return int(self._local_block(self.state.resources.value)
+                   [group, peer])
+
+    def voting_members(self, group: int) -> list[int]:
+        member = self._local_block(self.state.member)[group]
+        applied = self._local_block(self.state.applied_index)[group]
+        mask = int(member[int(np.argmax(applied))])
+        return [p for p in range(self.num_peers) if (mask >> p) & 1]

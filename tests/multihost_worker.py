@@ -1,0 +1,58 @@
+"""SPMD worker for the multi-host test: both processes run THIS program
+(the lockstep contract), each serving clients for its own 8 groups of a
+16-group cluster sharded over 2 processes × 4 virtual CPU devices.
+Launched by tests/test_multihost.py; prints one RESULT line for the
+parent to assert on."""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from copycat_tpu.ops import apply as ap  # noqa: E402
+from copycat_tpu.parallel import multihost  # noqa: E402
+
+
+def main() -> None:
+    coord, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    multihost.initialize(coord, num_processes=nproc, process_id=pid)
+    assert jax.process_count() == nproc
+    rg = multihost.MultiHostRaftGroups(groups_per_process=8, num_peers=3,
+                                       log_slots=32)
+    rg.wait_for_leaders()
+
+    # wave 1: one counter add per local group, distinct deltas
+    tags = [rg.submit(g, ap.OP_LONG_ADD, g + 1) for g in range(8)]
+    rg.run_until(tags, max_rounds=150)
+    r1 = [rg.results[t] for t in tags]
+
+    # wave 2: bulk path on the same groups (prefix sums prove FIFO)
+    tags2 = rg.submit_batch(np.arange(8), ap.OP_LONG_ADD, 1).tolist()
+    rg.run_until(tags2, max_rounds=150)
+    r2 = [rg.results[t] for t in tags2]
+
+    # fast query lane (runs in lockstep every round on every process)
+    qt = rg.submit_query(0, ap.OP_VALUE_GET)
+    rg.run_until([qt], max_rounds=100)
+    # lockstep ad-hoc read + local membership view
+    v1 = rg.serve_query(1, ap.OP_VALUE_GET)
+
+    print("RESULT " + json.dumps(
+        {"pid": pid, "r1": r1, "r2": r2, "q": rg.results[qt], "v1": v1,
+         "members0": rg.voting_members(0),
+         "leader0": rg.leader(0)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
