@@ -71,7 +71,12 @@ SCENARIO = os.environ.get("COPYCAT_BENCH_SCENARIO", "counter")
 GROUPS = int(os.environ.get(
     "COPYCAT_BENCH_GROUPS", "1000" if SCENARIO == "election" else "10000"))
 PEERS = int(os.environ.get("COPYCAT_BENCH_PEERS", "3"))
-LOG_SLOTS = int(os.environ.get("COPYCAT_BENCH_LOG_SLOTS", "64"))
+# The mixed config is [G,P,L]-bandwidth-bound: L=32 measured +11%
+# throughput and p50 106->31 ms vs L=64 at 100k x 5 (PERF.md round-3
+# continuation); the ring only needs to cover in-flight depth (S=16 with
+# backpressure). Other configs are smaller and keep the roomier default.
+LOG_SLOTS = int(os.environ.get("COPYCAT_BENCH_LOG_SLOTS",
+                               "32" if SCENARIO == "mixed" else "64"))
 ROUNDS = int(os.environ.get("COPYCAT_BENCH_ROUNDS", "200"))
 # Best-of-N: 5 reps (~0.3s each) buys insurance against tunnel/dispatch
 # jitter on the recorded number — observed session-to-session swings of

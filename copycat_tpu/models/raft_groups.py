@@ -281,7 +281,20 @@ class RaftGroups:
     # base engine stages host numpy straight onto the device and fetches
     # whole output arrays; a multi-process driver assembles GLOBAL arrays
     # from each process's local block and fetches only addressable shards.
-    _always_serve_queries = False
+    # _agree/_any_across are the lockstep primitives: identity on one
+    # host, allgathered across processes — every driver loop that stops
+    # or branches around a collective program decides through them, so
+    # the multi-host subclass needs no copied control flow.
+
+    def _agree(self, mine: bool) -> bool:
+        """True when every process's local condition holds (identity on
+        a single host)."""
+        return mine
+
+    def _any_across(self, mine: bool) -> bool:
+        """True when any process's local condition holds (identity on a
+        single host)."""
+        return mine
 
     def _stage_submits(self, submits: Submits) -> Submits:
         return submits
@@ -332,7 +345,7 @@ class RaftGroups:
         # leader's within its own round).
         if not explicit:
             self._record_assigned(submits, out)
-        if self._query_queues or self._always_serve_queries:
+        if self._any_across(bool(self._query_queues)):
             self._serve_queries()
         # Followers lagging beyond the ring window can't be served by
         # AppendEntries: install a snapshot of the leader's lane (log ring +
@@ -368,14 +381,18 @@ class RaftGroups:
         sub.valid[group, 0] = True
         atomic = np.zeros_like(sub.valid)
         atomic[group, 0] = consistency == "atomic"
+        mine = False
         for _ in range(max_attempts):
             results, served = self._run_query(sub, atomic)
-            if bool(served[group, 0]):
+            mine = bool(served[group, 0])
+            if self._agree(mine):
                 self.metrics.counter("queries_served").inc()
                 return int(results[group, 0])
             self.step_round()  # no leader yet / applied < commit: settle
         raise TimeoutError(
-            f"group {group} query unservable after {max_attempts} rounds")
+            f"group {group} query unservable after {max_attempts} rounds"
+            + (" (local read was served; a peer process is stuck)"
+               if mine else ""))
 
     def _serve_queries(self) -> None:
         """Drain the query lane: serve from the leader's applied state; a
@@ -565,20 +582,25 @@ class RaftGroups:
             self.step_round()
 
     def run_until(self, tags: list[int], max_rounds: int = 200) -> None:
-        """Step until all given tags have results (or raise)."""
+        """Step until all given tags have results (or raise). Lockstep on
+        multi-host: every process passes ITS tags ([] if idle) and all
+        stop together."""
         for _ in range(max_rounds):
-            if all(t in self.results for t in tags):
+            if self._agree(all(t in self.results for t in tags)):
                 return
             self.step_round()
         missing = [t for t in tags if t not in self.results]
-        raise TimeoutError(f"ops not committed after {max_rounds} rounds: {missing}")
+        raise TimeoutError(
+            f"ops not committed after {max_rounds} rounds: "
+            f"{missing if missing else 'local tags done — a peer process is stuck'}")
 
     def wait_for_leaders(self, max_rounds: int = 100) -> np.ndarray:
-        """Step until every group has a leader; returns leader indices [G]."""
+        """Step until every group has a leader; returns leader indices [G]
+        (this process's local groups on multi-host)."""
         for _ in range(max_rounds):
             out = self.step_round()
             leaders = np.asarray(out.leader)
-            if (leaders >= 0).all():
+            if self._agree(bool((leaders >= 0).all())):
                 return leaders
         raise TimeoutError(f"not all groups elected a leader in {max_rounds} rounds")
 

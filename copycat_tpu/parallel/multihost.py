@@ -20,12 +20,14 @@ Usage (same program on every process — SPMD):
     rg.run_until([tag])              # lockstep-coordinated
 
 LOCKSTEP CONTRACT: ``step_round`` launches a collective program, so all
-processes must call it the same number of times. The coordination-aware
-methods here (`run_until`, `wait_for_leaders`) agree globally before
-stopping; anything else that steps conditionally must be driven
-symmetrically on every process. Verified end-to-end by
-``tests/test_multihost.py`` (two real processes over a loopback
-coordinator on the CPU backend).
+processes must call it the same number of times. Every stop/branch
+decision in the driver loops (`run_until`, `wait_for_leaders`,
+`serve_query`, the serve-queries gate inside `step_round`) flows through
+the `_agree`/`_any_across` hooks, which allgather here — so the standard
+`RaftGroups` API is lockstep-safe as long as each process calls the same
+methods (with its own local arguments; `run_until([])` when idle).
+Verified end-to-end by ``tests/test_multihost.py`` (two real processes
+over a loopback coordinator on the CPU backend).
 """
 
 from __future__ import annotations
@@ -78,8 +80,6 @@ class MultiHostRaftGroups(RaftGroups):
     ``groups_per_process`` groups whose shards live on its devices.
     Group indices in the public API are process-LOCAL (0..Gp-1); the
     global group id is ``group + group_offset``."""
-
-    _always_serve_queries = True  # query program must run in lockstep
 
     def __init__(self, groups_per_process: int, num_peers: int = 3,
                  log_slots: int = 64, submit_slots: int = 4,
@@ -178,64 +178,23 @@ class MultiHostRaftGroups(RaftGroups):
                                       g_atomic)
         return self._local_block(results), self._local_block(served)
 
-    # -- lockstep-coordinated drivers ------------------------------------
+    # -- lockstep agreement primitives -------------------------------------
+    # The base driver loops (run_until, wait_for_leaders, serve_query,
+    # the serve-queries gate in step_round) decide through these, so the
+    # control flow lives in ONE place; here they allgather so every
+    # process takes the same branch around every collective program.
 
-    def _all_processes(self, mine: bool) -> bool:
+    @staticmethod
+    def _gather_flags(mine: bool) -> np.ndarray:
         from jax.experimental import multihost_utils
-        flags = multihost_utils.process_allgather(
-            np.asarray(mine, dtype=bool))
-        return bool(np.asarray(flags).all())
+        return np.asarray(
+            multihost_utils.process_allgather(np.asarray(mine, bool)))
 
-    def run_until(self, tags: list[int], max_rounds: int = 200) -> None:
-        """Step in lockstep until every process has results for ITS
-        tags (each process passes its own list; pass [] if idle)."""
-        for _ in range(max_rounds):
-            if self._all_processes(all(t in self.results for t in tags)):
-                return
-            self.step_round()
-        missing = [t for t in tags if t not in self.results]
-        raise TimeoutError(
-            f"ops not committed after {max_rounds} rounds: {missing}")
+    def _agree(self, mine: bool) -> bool:
+        return bool(self._gather_flags(mine).all())
 
-    def wait_for_leaders(self, max_rounds: int = 100) -> np.ndarray:
-        """Step in lockstep until every process's local groups all have
-        leaders; returns this process's local leader indices."""
-        leaders = None
-        for _ in range(max_rounds):
-            out = self.step_round()
-            leaders = np.asarray(out.leader)
-            if self._all_processes(bool((leaders >= 0).all())):
-                return leaders
-        raise TimeoutError(
-            f"not all groups elected a leader in {max_rounds} rounds")
-
-    def serve_query(self, group: int, opcode: int, a: int = 0, b: int = 0,
-                    c: int = 0, max_attempts: int = 50,
-                    consistency: str = "sequential") -> int:
-        """Lockstep variant of the ad-hoc read: EVERY process must call
-        this symmetrically (its own group/op); all keep evaluating the
-        query program — and stepping when anyone is unserved — until
-        every process's read is served."""
-        from ..ops.apply import QUERY_OPCODES
-        if opcode not in QUERY_OPCODES:
-            raise ValueError(
-                f"opcode {opcode} is not read-only; submit it as a command")
-        sub = self._empty_submits()
-        sub.opcode[group, 0] = opcode
-        sub.a[group, 0] = a
-        sub.b[group, 0] = b
-        sub.c[group, 0] = c
-        sub.valid[group, 0] = True
-        atomic = np.zeros_like(sub.valid)
-        atomic[group, 0] = consistency == "atomic"
-        for _ in range(max_attempts):
-            results, served = self._run_query(sub, atomic)
-            if self._all_processes(bool(served[group, 0])):
-                self.metrics.counter("queries_served").inc()
-                return int(results[group, 0])
-            self.step_round()
-        raise TimeoutError(
-            f"group {group} query unservable after {max_attempts} rounds")
+    def _any_across(self, mine: bool) -> bool:
+        return bool(self._gather_flags(mine).any())
 
     # -- local views -------------------------------------------------------
 

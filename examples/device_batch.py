@@ -1,0 +1,72 @@
+"""Device-engine example: the reference's resource API served by the TPU
+batch (no analogue in the reference — its consensus core was an external
+JAR; here it is the compiled XLA step, selected at server build time per
+SURVEY.md §7.1, mirroring ``withStateMachine`` at
+``AtomixReplica.java:374``).
+
+Runs a 3-server in-process cluster whose fixed-shape resources
+(counters, maps, locks) execute on the batched device engine — one
+group per resource instance — while staying behind the exact same
+``Atomix`` facade the CPU path serves:
+
+    python examples/device_batch.py [num_counters]
+
+Works on CPU too (the engine is the same jitted program; JAX picks the
+backend).
+"""
+
+import asyncio
+import sys
+
+from copycat_tpu.atomic import DistributedAtomicLong
+from copycat_tpu.collections import DistributedMap
+from copycat_tpu.coordination import DistributedLock
+from copycat_tpu.io.local import LocalServerRegistry, LocalTransport
+from copycat_tpu.io.transport import Address
+from copycat_tpu.manager.atomix import AtomixClient, AtomixServer
+from copycat_tpu.manager.device_executor import DeviceEngineConfig
+
+
+async def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    registry = LocalServerRegistry()
+    addrs = [Address("local", 5000 + i) for i in range(3)]
+    servers = [
+        AtomixServer(a, addrs, LocalTransport(registry),
+                     election_timeout=0.2, heartbeat_interval=0.04,
+                     session_timeout=10.0, executor="tpu",
+                     engine_config=DeviceEngineConfig(
+                         capacity=max(16, n + 4), num_peers=3,
+                         log_slots=32))
+        for a in addrs
+    ]
+    await asyncio.gather(*(s.open() for s in servers))
+    client = AtomixClient(addrs, LocalTransport(registry),
+                          session_timeout=10.0)
+    await client.open()
+    print(f"3-server cluster up; device engine hosts the resources")
+
+    # n independent counters -> n device groups, one batch
+    counters = [await client.get(f"counter-{i}", DistributedAtomicLong)
+                for i in range(n)]
+    for round_no in range(3):
+        totals = await asyncio.gather(
+            *(c.add_and_get(i + 1) for i, c in enumerate(counters)))
+        print(f"round {round_no}: counters -> {totals}")
+
+    table = await client.get("table", DistributedMap)
+    await table.put("answer", 42)
+    print("map get ->", await table.get("answer"))
+
+    lock = await client.get("gate", DistributedLock)
+    await lock.lock()
+    print("lock acquired; releasing")
+    await lock.unlock()
+
+    await client.close()
+    await asyncio.gather(*(s.close() for s in servers))
+    print("done")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
