@@ -232,6 +232,34 @@ def test_exactly_once_counter_across_churn():
     assert resolve(rg, t) == len(tags)
 
 
+def test_added_lane_catches_up_via_snapshot_install():
+    """A lane added AFTER the leader's ring has wrapped past genesis can
+    never be served by AppendEntries (its needed prefix is gone): the
+    stale→snapshot-install path must hand it the full state — including
+    the membership view — and it must then count toward the new quorum."""
+    rg = make(peers=5, voters=3, log_slots=16, submit_slots=8)
+    rg.wait_for_leaders()
+    # push well past L=16 entries so the ring has wrapped
+    tags = [rg.submit(0, ap.OP_LONG_ADD, 1) for _ in range(40)]
+    rg.run_until(tags, max_rounds=200)
+
+    t = rg.add_peer(0, 3)
+    resolve(rg, t, max_rounds=150)
+    for _ in range(40):  # replication/install rounds
+        rg.step_round()
+    member = np.asarray(rg.state.member[0])
+    applied = np.asarray(rg.state.applied_index[0])
+    # the added lane holds the full applied state and the 4-voter config
+    assert applied[3] == applied.max(), "added lane not caught up"
+    assert member[3] == 0b01111, f"installed view wrong: {member[3]:b}"
+    assert rg.value(0, peer=3) == 40
+
+    # and it genuinely votes: with original voter 0 cut, the 4-voter
+    # quorum (3) is reachable ONLY if the installed lane 3 acks —
+    # {1,2} alone is 2 < 3
+    assert commits_under(rg, isolate(rg, [0]), rounds=60)
+
+
 def test_api_validation():
     # raw config submits get add_peer/remove_peer's validation
     rg = make(peers=3)
