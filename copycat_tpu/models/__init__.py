@@ -11,8 +11,10 @@ from .device_resources import (  # noqa: F401
     DeviceLock,
     DeviceLong,
     DeviceMap,
+    DeviceMultiMap,
     DeviceQueue,
     DeviceResourceError,
     DeviceSet,
+    DeviceTopic,
     DeviceValue,
 )

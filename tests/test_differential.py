@@ -24,6 +24,7 @@ jax = pytest.importorskip("jax")
 from copycat_tpu.atomic import DistributedAtomicLong, DistributedAtomicValue
 from copycat_tpu.collections import (
     DistributedMap,
+    DistributedMultiMap,
     DistributedQueue,
     DistributedSet,
 )
@@ -32,6 +33,7 @@ from copycat_tpu.models import (
     DeviceLock,
     DeviceLong,
     DeviceMap,
+    DeviceMultiMap,
     DeviceQueue,
     DeviceSet,
     DeviceValue,
@@ -55,8 +57,10 @@ def _gen_ops(rng: random.Random, n: int) -> list[tuple]:
     ops = []
     queue_size = 0
     lock_holder = None  # None | "a" | "b"
+    mm_pairs: set = set()      # live (key, value) pairs; device pool is 16
     for _ in range(n):
-        kind = rng.choice(("value", "long", "map", "set", "queue", "lock"))
+        kind = rng.choice(("value", "long", "map", "set", "queue", "lock",
+                           "mmap"))
         if kind == "value":
             op = rng.choice(("get", "set", "cas", "get_and_set"))
             if op == "get":
@@ -105,6 +109,25 @@ def _gen_ops(rng: random.Random, n: int) -> list[tuple]:
                 queue_size -= 1
             ops.append(("queue", op,
                         (rng.choice(VALUES),) if op == "offer" else ()))
+        elif kind == "mmap":
+            k = rng.choice(KEYS[:5])
+            v = rng.choice(VALUES[:6])
+            op = rng.choice(("put", "remove_all", "remove_entry",
+                             "contains_key", "contains_entry",
+                             "contains_value", "count", "size", "is_empty"))
+            if op == "put" and len(mm_pairs | {(k, v)}) > 14:
+                op = "remove_all"  # stay under the device pair pool
+            if op == "put":
+                mm_pairs.add((k, v))
+            elif op == "remove_all":
+                mm_pairs = {p for p in mm_pairs if p[0] != k}
+            elif op == "remove_entry":
+                mm_pairs.discard((k, v))
+            args = {"put": (k, v), "remove_all": (k,),
+                    "remove_entry": (k, v), "contains_key": (k,),
+                    "contains_entry": (k, v), "contains_value": (v,),
+                    "count": (k,), "size": (), "is_empty": ()}[op]
+            ops.append(("mmap", op, args))
         else:  # lock
             if lock_holder is None:
                 who = rng.choice(("a", "b"))
@@ -134,6 +157,7 @@ class CpuPath:
         self.map = await self.client_a.get("map", DistributedMap)
         self.set = await self.client_a.get("set", DistributedSet)
         self.queue = await self.client_a.get("queue", DistributedQueue)
+        self.mmap = await self.client_a.get("mmap", DistributedMultiMap)
         self.lock = {"a": await self.client_a.get("lock", DistributedLock),
                      "b": await self.client_b.get("lock", DistributedLock)}
 
@@ -202,6 +226,26 @@ class CpuPath:
                 return await q.peek()
             if op == "size":
                 return await q.size()
+        if kind == "mmap":
+            mm = self.mmap
+            if op == "put":
+                return bool(await mm.put(*args))
+            if op == "remove_all":
+                return len(await mm.remove(*args))   # removed-values list
+            if op == "remove_entry":
+                return bool(await mm.remove(*args))
+            if op == "contains_key":
+                return bool(await mm.contains_key(*args))
+            if op == "contains_entry":
+                return bool(await mm.contains_entry(*args))
+            if op == "contains_value":
+                return bool(await mm.contains_value(*args))
+            if op == "count":
+                return await mm.size(*args)          # per-key size
+            if op == "size":
+                return await mm.size()
+            if op == "is_empty":
+                return bool(await mm.is_empty())
         if kind == "lock":
             (who,) = args
             if op in ("try_lock", "try_lock_contended"):
@@ -217,7 +261,7 @@ class DevicePath:
     def __init__(self):
         # one group per resource type: value/long share an opcode register,
         # so they must live in separate groups
-        self.rg = RaftGroups(6, 3, log_slots=64)
+        self.rg = RaftGroups(7, 3, log_slots=64)
         self.rg.wait_for_leaders()
         self.value = DeviceValue(self.rg, 0)
         self.long = DeviceLong(self.rg, 1)
@@ -226,6 +270,7 @@ class DevicePath:
         self.queue = DeviceQueue(self.rg, 4)
         self.lock = {"a": DeviceLock(self.rg, 5, 1),
                      "b": DeviceLock(self.rg, 5, 2)}
+        self.mmap = DeviceMultiMap(self.rg, 6)
 
     def run(self, kind, op, args):
         if kind == "value":
@@ -256,6 +301,15 @@ class DevicePath:
             q = self.queue
             return {"offer": q.offer, "poll": q.poll, "peek": q.peek,
                     "size": q.size}[op](*args)
+        if kind == "mmap":
+            mm = self.mmap
+            return {"put": mm.put, "remove_all": mm.remove,
+                    "remove_entry": mm.remove_entry,
+                    "contains_key": mm.contains_key,
+                    "contains_entry": mm.contains_entry,
+                    "contains_value": mm.contains_value,
+                    "count": mm.count, "size": mm.size,
+                    "is_empty": mm.is_empty}[op](*args)
         if kind == "lock":
             (who,) = args
             if op in ("try_lock", "try_lock_contended"):
