@@ -24,6 +24,15 @@ import os
 import sys
 import time
 
+# Site customization (e.g. a TPU plugin) may pin jax_platforms via
+# jax.config, overriding the JAX_PLATFORMS env var — re-assert the env
+# var so `JAX_PLATFORMS=cpu python -m copycat_tpu.testing.verdict` (the
+# CI smoke) really runs on CPU even where a plugin is installed.
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import numpy as np
 
 from ..models.raft_groups import RaftGroups
@@ -249,7 +258,11 @@ def _write_artifact(result: dict) -> None:
 
 def main() -> None:
     result = run_verdict()
-    _write_artifact(result)
+    # COPYCAT_VERDICT_ARTIFACT=0 skips rewriting LINEARIZABILITY.md — the
+    # committed artifact records the BENCH-scale verdict; smoke runs (CI,
+    # local debugging at small GROUPS) must not clobber it.
+    if os.environ.get("COPYCAT_VERDICT_ARTIFACT", "1") == "1":
+        _write_artifact(result)
     print(json.dumps(result))
     if not result["linearizable"]:
         raise SystemExit(1)
