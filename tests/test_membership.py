@@ -260,6 +260,31 @@ def test_added_lane_catches_up_via_snapshot_install():
     assert commits_under(rg, isolate(rg, [0]), rounds=60)
 
 
+def test_membership_sharded_over_mesh():
+    """The dynamic-membership path (latest-config view scans, masked
+    rank-select quorums, population_count) compiled and stepped over a
+    multi-device mesh — join, leave, and leader self-removal all work
+    with the group axis sharded (XLA inserts the collectives)."""
+    from copycat_tpu.parallel import make_mesh
+
+    mesh = make_mesh(groups=8)
+    rg = make(groups=16, voters=3, mesh=mesh)
+    rg.wait_for_leaders()
+    t = rg.submit(3, ap.OP_LONG_ADD, 9)
+    assert resolve(rg, t) == 9
+    t3 = rg.add_peer(3, 3)
+    t4 = rg.add_peer(3, 4)
+    rg.run_until([t3, t4], max_rounds=200)
+    assert rg.voting_members(3) == [0, 1, 2, 3, 4]
+    tr = rg.remove_peer(3, rg.leader(3))
+    rg.run_until([tr], max_rounds=200)
+    assert len(rg.voting_members(3)) == 4
+    t = rg.submit(3, ap.OP_LONG_ADD, 1)
+    assert resolve(rg, t) == 10
+    # untouched groups keep the initial 3-voter config
+    assert rg.voting_members(0) == [0, 1, 2]
+
+
 def test_api_validation():
     # raw config submits get add_peer/remove_peer's validation
     rg = make(peers=3)
