@@ -16,7 +16,6 @@ from ..io.buffer import BufferInput, BufferOutput
 from ..io.serializer import Serializer, serialize_with
 from ..io.transport import Address, Connection, Transport, TransportError
 from ..resource.resource import AbstractResource, resource_info
-from ..utils.listeners import Listener
 from . import commands as c
 from .state import MessageBusState
 

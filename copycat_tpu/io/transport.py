@@ -10,7 +10,6 @@ Implementations: :mod:`local` (in-memory, the test substrate) and :mod:`tcp`
 from __future__ import annotations
 
 import abc
-import asyncio
 from dataclasses import dataclass
 from typing import Any, Awaitable, Callable
 

@@ -20,7 +20,6 @@ from functools import lru_cache, partial
 from typing import Any
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..ops.apply import OP_CFG_ADD, OP_CFG_REMOVE

@@ -4,7 +4,7 @@
 
 from __future__ import annotations
 
-from typing import Any, Callable, Type, TypeVar
+from typing import Any, Callable, TypeVar
 
 from ..protocol.operations import Command, Operation, Query
 from .consistency import Consistency

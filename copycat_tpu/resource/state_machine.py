@@ -13,7 +13,7 @@ from __future__ import annotations
 from typing import Any, Callable
 
 from ..server.state_machine import Commit, StateMachine, StateMachineExecutor
-from .operations import DeleteCommand, ResourceCommand, ResourceOperation, ResourceQuery
+from .operations import DeleteCommand, ResourceOperation
 
 
 class ResourceCommit(Commit):

@@ -21,7 +21,7 @@ import logging
 import os
 import random
 import time
-from typing import Any, Callable
+from typing import Any
 
 from ..io.transport import Address, Connection, Transport, TransportError
 from ..protocol import messages as msg
