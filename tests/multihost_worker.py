@@ -42,6 +42,18 @@ def main() -> None:
     rg.run_until(tags2, max_rounds=150)
     r2 = [rg.results[t] for t in tags2]
 
+    # partition phase: cut peer lane 2 everywhere for a while with ops
+    # in flight — commits continue on {0,1} quorums, and any op lost to
+    # a deposed leader is re-submitted by the per-process retry protocol
+    cut = np.ones((8, 3, 3), bool)
+    cut[:, 2, :] = False
+    cut[:, :, 2] = False
+    t_part = [rg.submit(g, ap.OP_LONG_ADD, 10) for g in range(8)]
+    for _ in range(12):  # FIXED count — a local break would diverge lockstep
+        rg.step_round(deliver=cut)
+    rg.run_until(t_part, max_rounds=150)  # heal + lockstep drain
+    r3 = [rg.results[t] for t in t_part]
+
     # fast query lane (runs in lockstep every round on every process)
     qt = rg.submit_query(0, ap.OP_VALUE_GET)
     rg.run_until([qt], max_rounds=100)
@@ -49,8 +61,8 @@ def main() -> None:
     v1 = rg.serve_query(1, ap.OP_VALUE_GET)
 
     print("RESULT " + json.dumps(
-        {"pid": pid, "r1": r1, "r2": r2, "q": rg.results[qt], "v1": v1,
-         "members0": rg.voting_members(0),
+        {"pid": pid, "r1": r1, "r2": r2, "r3": r3, "q": rg.results[qt],
+         "v1": v1, "members0": rg.voting_members(0),
          "leader0": rg.leader(0)}), flush=True)
 
 

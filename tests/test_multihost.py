@@ -61,10 +61,11 @@ def test_two_process_cluster():
                 results[d["pid"]] = d
     assert set(results) == {0, 1}, f"missing worker results: {outs}"
     for pid, d in results.items():
-        # wave 1 deltas g+1 from zero -> value g+1; wave 2 adds 1 more
+        # wave 1 deltas g+1 from zero -> g+1; wave 2 +1; partition wave +10
         assert d["r1"] == [g + 1 for g in range(8)], (pid, d)
         assert d["r2"] == [g + 2 for g in range(8)], (pid, d)
-        assert d["q"] == 2, (pid, d)
-        assert d["v1"] == 3, (pid, d)  # group 1: (1+1) + 1
+        assert d["r3"] == [g + 12 for g in range(8)], (pid, d)
+        assert d["q"] == 12, (pid, d)   # group 0 after the partition wave
+        assert d["v1"] == 13, (pid, d)  # group 1: 3 + 10
         assert d["members0"] == [0, 1, 2], (pid, d)
         assert 0 <= d["leader0"] < 3
