@@ -21,6 +21,9 @@ Scenarios (``COPYCAT_BENCH_SCENARIO``, BASELINE.md benchmark configs):
   (event-push grant path).
 - ``mixed`` (config #5): counter+map+lock mix with per-round random peer
   isolation (nemesis) across all groups.
+- ``host``: client-visible throughput through the full host runtime
+  (queue-managed ``submit_batch`` → harvest → results), the number a
+  framework client actually sees.
 """
 
 from __future__ import annotations
@@ -357,6 +360,55 @@ def run_throughput(scenario: str) -> dict:
     }
 
 
+def run_host() -> dict:
+    """Client-visible throughput: queue-managed ops through the FULL host
+    runtime (``RaftGroups.submit_batch`` → step → harvest → results),
+    including tag correlation, exactly-once retry bookkeeping and
+    latency metrics — the number a client of the framework actually
+    sees, as opposed to the raw-tensor scenarios that bypass the host
+    loop. BENCH_SCENARIOS.md documents both side by side."""
+    from .models import RaftGroups
+
+    rg = RaftGroups(GROUPS, PEERS, log_slots=LOG_SLOTS,
+                    submit_slots=SUBMIT_SLOTS,
+                    config=Config(use_pallas=USE_PALLAS,
+                                  append_window=max(4, SUBMIT_SLOTS),
+                                  applies_per_round=max(4, SUBMIT_SLOTS),
+                                  pool_budgets=POOL_BUDGETS,
+                                  resource=RESOURCE_CONFIGS["counter"]))
+    log(f"bench[host]: G={GROUPS} P={PEERS} {SUBMIT_SLOTS} queue-managed "
+        f"ops/group/burst; device={jax.devices()[0].platform}")
+    rg.wait_for_leaders()
+    groups = np.repeat(np.arange(GROUPS), SUBMIT_SLOTS)
+
+    def burst() -> float:
+        t0 = time.perf_counter()
+        tags = rg.submit_batch(groups, ap.OP_LONG_ADD, 1).tolist()
+        rg.run_until(tags, max_rounds=60)
+        return len(tags) / (time.perf_counter() - t0)
+
+    burst()  # warm (jit compile + first transfers)
+    best = 0.0
+    for rep in range(REPEATS):
+        with xla_trace(PROFILE_DIR if rep == 0 else None):
+            ops = burst()
+        best = max(best, ops)
+        log(f"bench[host]: rep {rep}: {ops:,.0f} committed ops/sec "
+            f"host-observed")
+    lat = rg.metrics.histogram("commit_latency_rounds")
+    return {
+        "metric": f"host_observed_committed_ops_per_sec_{GROUPS}_groups",
+        "value": round(best, 1),
+        "unit": "ops/sec",
+        "vs_baseline": round(best / NORTH_STAR_OPS, 4),
+        # host-observed submit->harvest latency in driver rounds (the
+        # client-visible definition; BENCH_SCENARIOS.md contrasts it with
+        # the device-measured append->apply number)
+        "p50_commit_latency_rounds": lat.percentile(50),
+        "p99_commit_latency_rounds": lat.percentile(99),
+    }
+
+
 def run_election() -> dict:
     """Config #2: forced leader churn; measures elections completed/sec."""
     config = Config(use_pallas=USE_PALLAS,
@@ -493,11 +545,13 @@ def main() -> None:
         result = run_election()
     elif SCENARIO == "map_read":
         result = run_map_read()
+    elif SCENARIO == "host":
+        result = run_host()
     elif SCENARIO in SUBMIT_BUILDERS:
         result = run_throughput(SCENARIO)
     else:
         raise SystemExit(f"unknown scenario {SCENARIO!r}; pick one of "
-                         f"{['election', 'map_read', *SUBMIT_BUILDERS]}")
+                         f"{['election', 'map_read', 'host', *SUBMIT_BUILDERS]}")
     print(json.dumps(result))
 
 
