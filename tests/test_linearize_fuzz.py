@@ -1,0 +1,122 @@
+"""Fuzz the Wing & Gong checker against brute-force exhaustive search.
+
+The checker is the trust anchor behind LINEARIZABILITY.md and the verdict
+runner, so it gets its own oracle: for random tiny histories (≤6 ops,
+random overlap windows, random results — most of them NOT linearizable),
+a brute-force reference decides linearizability by trying EVERY
+permutation of completed ops (with every subset/interleaving of
+incomplete ones) against the sequential model and the real-time partial
+order. The two verdicts must agree on every history.
+"""
+
+import itertools
+import math
+import random
+
+# deliberately NO jax gate: the checker, the models and this oracle are
+# pure stdlib — the trust anchor must run everywhere
+from copycat_tpu.testing.linearize import (
+    HOp,
+    RegisterModel,
+    check_linearizable,
+)
+
+
+def _random_op(rng: random.Random) -> tuple:
+    kind = rng.choice(("set", "get", "cas", "add"))
+    if kind == "set":
+        return ("set", rng.randint(1, 3))
+    if kind == "get":
+        return ("get",)
+    if kind == "cas":
+        return ("cas", rng.randint(0, 3), rng.randint(1, 3))
+    return ("add", rng.randint(1, 2))
+
+
+def brute_force(history, model) -> bool:
+    """Exhaustive reference: a history is linearizable iff SOME total
+    order of (all completed ops + any subset of incomplete ops) respects
+    the real-time partial order and replays through the model with
+    matching results."""
+    completed = [h for h in history if h.complete != math.inf]
+    incomplete = [h for h in history if h.complete == math.inf]
+    for r in range(len(incomplete) + 1):
+        for subset in itertools.combinations(incomplete, r):
+            ops = completed + list(subset)
+            for perm in itertools.permutations(ops):
+                # real-time: a must precede b if a completed before b invoked
+                ok = True
+                for i, a in enumerate(perm):
+                    for b in perm[i + 1:]:
+                        if b.complete < a.invoke:
+                            ok = False
+                            break
+                    if not ok:
+                        break
+                if not ok:
+                    continue
+                state = model.init
+                good = True
+                for h in perm:
+                    state, res = model.apply(state, h.op)
+                    if h.result is not None and res != h.result:
+                        good = False
+                        break
+                if good:
+                    return True
+    return False
+
+
+def _random_history(rng: random.Random) -> list:
+    n = rng.randint(2, 6)
+    hist = []
+    for i in range(n):
+        op = _random_op(rng)
+        invoke = rng.randint(0, 6)
+        if rng.random() < 0.15:
+            complete, result = math.inf, None
+        else:
+            complete = invoke + rng.randint(0, 4)
+            # results drawn from a small range: many histories will be
+            # UNlinearizable, exercising the reject path hard
+            result = rng.randint(0, 4)
+        hist.append(HOp(op_id=i, op=op, result=result, invoke=invoke,
+                        complete=complete))
+    return hist
+
+
+def _valid_history(rng: random.Random) -> list:
+    """A history produced by an actual sequential execution with TRUE
+    model results, then with invocation windows randomly WIDENED — still
+    linearizable by construction (the original order remains a valid
+    witness), but with real concurrency for the search to untangle."""
+    n = rng.randint(2, 6)
+    state = RegisterModel.init
+    hist = []
+    t = 0
+    for i in range(n):
+        op = _random_op(rng)
+        state, res = RegisterModel.apply(state, op)
+        invoke = max(0, t - rng.randint(0, 3))   # widen backwards
+        complete = t + rng.randint(0, 3)         # widen forwards
+        if rng.random() < 0.1:
+            complete, res = math.inf, None       # crashed client
+        hist.append(HOp(op_id=i, op=op, result=res, invoke=invoke,
+                        complete=complete))
+        t += 1
+    return hist
+
+
+def test_checker_matches_brute_force():
+    rng = random.Random(97)
+    agree_yes = agree_no = 0
+    for k in range(400):
+        hist = (_valid_history(rng) if k % 2 == 0
+                else _random_history(rng))
+        expected = brute_force(hist, RegisterModel)
+        got = check_linearizable(hist, RegisterModel).ok
+        assert got == expected, f"checker={got} brute={expected}: {hist}"
+        agree_yes += expected
+        agree_no += not expected
+    # the fuzz must genuinely exercise both verdicts
+    assert agree_yes > 40 and agree_no > 40, (agree_yes, agree_no)
