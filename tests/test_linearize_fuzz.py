@@ -13,24 +13,39 @@ import itertools
 import math
 import random
 
+import pytest
+
 # deliberately NO jax gate: the checker, the models and this oracle are
 # pure stdlib — the trust anchor must run everywhere
 from copycat_tpu.testing.linearize import (
     HOp,
+    LockModel,
+    MapModel,
     RegisterModel,
     check_linearizable,
 )
 
 
-def _random_op(rng: random.Random) -> tuple:
-    kind = rng.choice(("set", "get", "cas", "add"))
-    if kind == "set":
-        return ("set", rng.randint(1, 3))
-    if kind == "get":
-        return ("get",)
-    if kind == "cas":
-        return ("cas", rng.randint(0, 3), rng.randint(1, 3))
-    return ("add", rng.randint(1, 2))
+OP_GENS = {
+    RegisterModel: lambda rng: rng.choice((
+        lambda: ("set", rng.randint(1, 3)),
+        lambda: ("get",),
+        lambda: ("cas", rng.randint(0, 3), rng.randint(1, 3)),
+        lambda: ("add", rng.randint(1, 2))))(),
+    MapModel: lambda rng: rng.choice((
+        lambda: ("put", rng.randint(1, 2), rng.randint(1, 3)),
+        lambda: ("get", rng.randint(1, 2)),
+        lambda: ("remove", rng.randint(1, 2)),
+        lambda: ("contains", rng.randint(1, 2)),
+        lambda: ("size",)))(),
+    LockModel: lambda rng: rng.choice((
+        lambda: ("acquire", rng.randint(1, 2)),
+        lambda: ("release", rng.randint(1, 2))))(),
+}
+
+
+def _random_op(rng: random.Random, model=RegisterModel) -> tuple:
+    return OP_GENS[model](rng)
 
 
 def brute_force(history, model) -> bool:
@@ -67,11 +82,11 @@ def brute_force(history, model) -> bool:
     return False
 
 
-def _random_history(rng: random.Random) -> list:
+def _random_history(rng: random.Random, model=RegisterModel) -> list:
     n = rng.randint(2, 6)
     hist = []
     for i in range(n):
-        op = _random_op(rng)
+        op = _random_op(rng, model)
         invoke = rng.randint(0, 6)
         if rng.random() < 0.15:
             complete, result = math.inf, None
@@ -85,18 +100,18 @@ def _random_history(rng: random.Random) -> list:
     return hist
 
 
-def _valid_history(rng: random.Random) -> list:
+def _valid_history(rng: random.Random, model=RegisterModel) -> list:
     """A history produced by an actual sequential execution with TRUE
     model results, then with invocation windows randomly WIDENED — still
     linearizable by construction (the original order remains a valid
     witness), but with real concurrency for the search to untangle."""
     n = rng.randint(2, 6)
-    state = RegisterModel.init
+    state = model.init
     hist = []
     t = 0
     for i in range(n):
-        op = _random_op(rng)
-        state, res = RegisterModel.apply(state, op)
+        op = _random_op(rng, model)
+        state, res = model.apply(state, op)
         invoke = max(0, t - rng.randint(0, 3))   # widen backwards
         complete = t + rng.randint(0, 3)         # widen forwards
         if rng.random() < 0.1:
@@ -107,14 +122,16 @@ def _valid_history(rng: random.Random) -> list:
     return hist
 
 
-def test_checker_matches_brute_force():
+@pytest.mark.parametrize("model", [RegisterModel, MapModel, LockModel],
+                         ids=["register", "map", "lock"])
+def test_checker_matches_brute_force(model):
     rng = random.Random(97)
     agree_yes = agree_no = 0
     for k in range(400):
-        hist = (_valid_history(rng) if k % 2 == 0
-                else _random_history(rng))
-        expected = brute_force(hist, RegisterModel)
-        got = check_linearizable(hist, RegisterModel).ok
+        hist = (_valid_history(rng, model) if k % 2 == 0
+                else _random_history(rng, model))
+        expected = brute_force(hist, model)
+        got = check_linearizable(hist, model).ok
         assert got == expected, f"checker={got} brute={expected}: {hist}"
         agree_yes += expected
         agree_no += not expected
