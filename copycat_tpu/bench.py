@@ -35,6 +35,11 @@ import time
 from functools import partial
 
 import jax
+
+from .utils.platform import honor_jax_platforms_env
+
+honor_jax_platforms_env()
+
 import jax.numpy as jnp
 import numpy as np
 

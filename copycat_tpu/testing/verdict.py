@@ -24,14 +24,9 @@ import os
 import sys
 import time
 
-# Site customization (e.g. a TPU plugin) may pin jax_platforms via
-# jax.config, overriding the JAX_PLATFORMS env var — re-assert the env
-# var so `JAX_PLATFORMS=cpu python -m copycat_tpu.testing.verdict` (the
-# CI smoke) really runs on CPU even where a plugin is installed.
-if os.environ.get("JAX_PLATFORMS"):
-    import jax
+from ..utils.platform import honor_jax_platforms_env
 
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+honor_jax_platforms_env()
 
 import numpy as np
 

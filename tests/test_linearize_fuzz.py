@@ -26,26 +26,24 @@ from copycat_tpu.testing.linearize import (
 )
 
 
-OP_GENS = {
-    RegisterModel: lambda rng: rng.choice((
-        lambda: ("set", rng.randint(1, 3)),
-        lambda: ("get",),
-        lambda: ("cas", rng.randint(0, 3), rng.randint(1, 3)),
-        lambda: ("add", rng.randint(1, 2))))(),
-    MapModel: lambda rng: rng.choice((
-        lambda: ("put", rng.randint(1, 2), rng.randint(1, 3)),
-        lambda: ("get", rng.randint(1, 2)),
-        lambda: ("remove", rng.randint(1, 2)),
-        lambda: ("contains", rng.randint(1, 2)),
-        lambda: ("size",)))(),
-    LockModel: lambda rng: rng.choice((
-        lambda: ("acquire", rng.randint(1, 2)),
-        lambda: ("release", rng.randint(1, 2))))(),
-}
-
-
 def _random_op(rng: random.Random, model=RegisterModel) -> tuple:
-    return OP_GENS[model](rng)
+    if model is MapModel:
+        kind = rng.choice(("put", "get", "remove", "contains", "size"))
+        if kind == "put":
+            return ("put", rng.randint(1, 2), rng.randint(1, 3))
+        if kind == "size":
+            return ("size",)
+        return (kind, rng.randint(1, 2))
+    if model is LockModel:
+        return (rng.choice(("acquire", "release")), rng.randint(1, 2))
+    kind = rng.choice(("set", "get", "cas", "add"))
+    if kind == "set":
+        return ("set", rng.randint(1, 3))
+    if kind == "get":
+        return ("get",)
+    if kind == "cas":
+        return ("cas", rng.randint(0, 3), rng.randint(1, 3))
+    return ("add", rng.randint(1, 2))
 
 
 def brute_force(history, model) -> bool:
