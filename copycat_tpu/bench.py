@@ -97,10 +97,16 @@ NORTH_STAR_OPS = 1_000_000.0
 # with the jnp path after the one-hot rewrite (PERF.md §Pallas A/B — the
 # step is dispatch-bound, not tally-bound), and running it keeps the
 # production kernel exercised. CPU keeps the jnp path (interpret mode is
-# test-only).
-USE_PALLAS = os.environ.get(
-    "COPYCAT_BENCH_PALLAS",
-    "1" if jax.default_backend() == "tpu" else "0") == "1"
+# test-only). Resolved LAZILY: jax.default_backend() initializes the
+# backend, which must not happen at import time — _require_devices()
+# gates it with a timeout first (a dead tunnel hangs enumeration).
+_PALLAS_ENV = os.environ.get("COPYCAT_BENCH_PALLAS")
+
+
+def use_pallas() -> bool:
+    if _PALLAS_ENV is not None:
+        return _PALLAS_ENV == "1"
+    return jax.default_backend() == "tpu"
 # Per-pool apply budgets (value,map,set,queue,lock,election): budgets
 # select the conflict-partitioned apply path (ops/consensus.py
 # Config.pool_budgets); empty = the single sequential scan.
@@ -247,7 +253,7 @@ def elect_all(state, jit_step, empty, deliver, key, G):
 
 
 def run_throughput(scenario: str) -> dict:
-    config = Config(use_pallas=USE_PALLAS,
+    config = Config(use_pallas=use_pallas(),
                     append_window=max(4, SUBMIT_SLOTS),
                     applies_per_round=max(4, SUBMIT_SLOTS),
                     pool_budgets=POOL_BUDGETS,
@@ -376,7 +382,7 @@ def run_host() -> dict:
 
     rg = RaftGroups(GROUPS, PEERS, log_slots=LOG_SLOTS,
                     submit_slots=SUBMIT_SLOTS,
-                    config=Config(use_pallas=USE_PALLAS,
+                    config=Config(use_pallas=use_pallas(),
                                   append_window=max(4, SUBMIT_SLOTS),
                                   applies_per_round=max(4, SUBMIT_SLOTS),
                                   pool_budgets=POOL_BUDGETS,
@@ -416,7 +422,7 @@ def run_host() -> dict:
 
 def run_election() -> dict:
     """Config #2: forced leader churn; measures elections completed/sec."""
-    config = Config(use_pallas=USE_PALLAS,
+    config = Config(use_pallas=use_pallas(),
                     resource=RESOURCE_CONFIGS["election"])
     key = jax.random.PRNGKey(0)
     key, init_key = jax.random.split(key)
@@ -481,7 +487,7 @@ def run_map_read() -> dict:
         raise SystemExit(
             f"COPYCAT_BENCH_READ_LEVEL={read_level!r}: pick 'sequential' "
             f"or 'atomic' (a typo here would silently mislabel the metric)")
-    config = Config(use_pallas=USE_PALLAS, append_window=max(4, SUBMIT_SLOTS),
+    config = Config(use_pallas=use_pallas(), append_window=max(4, SUBMIT_SLOTS),
                     applies_per_round=max(4, SUBMIT_SLOTS),
                     resource=RESOURCE_CONFIGS["map"])
     key = jax.random.PRNGKey(0)
@@ -546,6 +552,10 @@ def run_map_read() -> dict:
 
 
 def main() -> None:
+    # fail fast (exit 2) when the tunneled accelerator is unreachable —
+    # a dead tunnel otherwise hangs device enumeration forever
+    from .utils.platform import require_devices
+    require_devices(env="COPYCAT_BENCH_DEVICE_TIMEOUT")
     if SCENARIO == "election":
         result = run_election()
     elif SCENARIO == "map_read":

@@ -252,6 +252,8 @@ def _write_artifact(result: dict) -> None:
 
 
 def main() -> None:
+    from ..utils.platform import require_devices
+    require_devices(env="COPYCAT_VERDICT_DEVICE_TIMEOUT")
     result = run_verdict()
     # COPYCAT_VERDICT_ARTIFACT=0 skips rewriting LINEARIZABILITY.md — the
     # committed artifact records the BENCH-scale verdict; smoke runs (CI,

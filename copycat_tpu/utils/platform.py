@@ -18,3 +18,45 @@ def honor_jax_platforms_env() -> None:
         import jax
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def require_devices(env: str = "COPYCAT_DEVICE_TIMEOUT",
+                    default_s: float = 300.0) -> None:
+    """Fail FAST (exit 2) when the accelerator is unreachable.
+
+    Device enumeration through a tunneled TPU backend can hang
+    indefinitely when the tunnel is down (observed: ``jax.devices()``
+    blocks forever), which wedges any pipeline that runs an entry point
+    and waits on it. Healthy enumeration takes well under a minute, so a
+    generous timeout (``env`` seconds, default ``default_s``) cleanly
+    separates 'slow' from 'dead'. Call at the top of device-touching
+    entry points, before any other backend use.
+    """
+    import sys
+    import threading
+
+    import jax
+
+    timeout_s = float(os.environ.get(env, str(default_s)))
+    result: dict = {}
+
+    def probe() -> None:
+        try:
+            result["devices"] = jax.devices()
+        except Exception as e:  # noqa: BLE001 — report any backend error
+            result["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    err = sys.stderr
+    if t.is_alive():
+        print(f"FATAL: device enumeration did not return within "
+              f"{timeout_s:.0f}s — accelerator/tunnel unreachable",
+              file=err, flush=True)
+        os._exit(2)  # the probe thread holds the backend lock — hard exit
+    if "error" in result:
+        print(f"FATAL: device enumeration failed: {result['error']!r}",
+              file=err, flush=True)
+        raise SystemExit(2)
+    print(f"devices: {result['devices']}", file=err, flush=True)
