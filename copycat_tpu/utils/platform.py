@@ -20,43 +20,95 @@ def honor_jax_platforms_env() -> None:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
+# Run by subprocess probes: mirrors the parent's platform selection
+# (honor_jax_platforms_env) so the probe enumerates the same backends the
+# parent is about to.
+_PROBE_CODE = """
+import os
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+import jax
+print(jax.devices(), flush=True)
+"""
+
+
 def require_devices(env: str = "COPYCAT_DEVICE_TIMEOUT",
-                    default_s: float = 300.0) -> None:
-    """Fail FAST (exit 2) when the accelerator is unreachable.
+                    default_s: float = 120.0,
+                    probes_env: str = "COPYCAT_DEVICE_PROBES",
+                    default_probes: int = 3,
+                    retry_wait_s: float = 60.0) -> None:
+    """Fail fast (exit 2) when the accelerator is unreachable — with retries.
 
     Device enumeration through a tunneled TPU backend can hang
     indefinitely when the tunnel is down (observed: ``jax.devices()``
     blocks forever), which wedges any pipeline that runs an entry point
-    and waits on it. Healthy enumeration takes well under a minute, so a
-    generous timeout (``env`` seconds, default ``default_s``) cleanly
-    separates 'slow' from 'dead'. Call at the top of device-touching
-    entry points, before any other backend use.
+    and waits on it. The tunnel's outages are usually *transient* (round-3
+    post-mortem: a single dead window at snapshot time zeroed out a whole
+    round's benchmark evidence), so a single fail-fast probe is too
+    brittle: this probes in SUBPROCESSES — a hung child is killed without
+    poisoning this process's backend lock — up to ``default_probes`` times
+    (``probes_env``), each bounded by ``default_s`` seconds (``env``),
+    waiting ``retry_wait_s`` between attempts. Only after a probe succeeds
+    does the parent enumerate in-process (still under a thread-timeout
+    guard, in case the tunnel dies in the gap). Call at the top of
+    device-touching entry points, before any other backend use.
     """
+    import subprocess
     import sys
     import threading
-
-    import jax
+    import time
 
     timeout_s = float(os.environ.get(env, str(default_s)))
+    n_probes = max(1, int(os.environ.get(probes_env, str(default_probes))))
+    err = sys.stderr
+
+    for attempt in range(1, n_probes + 1):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_CODE],
+                capture_output=True, text=True, timeout=timeout_s)
+            if out.returncode == 0 and out.stdout.strip():
+                print(f"devices (probe {attempt}/{n_probes}): "
+                      f"{out.stdout.strip()}", file=err, flush=True)
+                break
+            detail = (out.stderr or out.stdout).strip()[-500:]
+            print(f"probe {attempt}/{n_probes}: enumeration failed "
+                  f"(rc={out.returncode}): {detail}", file=err, flush=True)
+        except subprocess.TimeoutExpired:
+            print(f"probe {attempt}/{n_probes}: no response within "
+                  f"{timeout_s:.0f}s — accelerator/tunnel unreachable",
+                  file=err, flush=True)
+        if attempt < n_probes:
+            print(f"retrying in {retry_wait_s:.0f}s...", file=err, flush=True)
+            time.sleep(retry_wait_s)
+    else:
+        print(f"FATAL: accelerator unreachable after {n_probes} probes",
+              file=err, flush=True)
+        raise SystemExit(2)
+
+    # The probe proved the backend healthy moments ago; now bind it
+    # in-process. Keep a thread-timeout guard for the race where the
+    # tunnel dies between probe and bind.
+    import jax
+
     result: dict = {}
 
-    def probe() -> None:
+    def bind() -> None:
         try:
             result["devices"] = jax.devices()
         except Exception as e:  # noqa: BLE001 — report any backend error
             result["error"] = e
 
-    t = threading.Thread(target=probe, daemon=True)
+    t = threading.Thread(target=bind, daemon=True)
     t.start()
     t.join(timeout_s)
-    err = sys.stderr
     if t.is_alive():
-        print(f"FATAL: device enumeration did not return within "
-              f"{timeout_s:.0f}s — accelerator/tunnel unreachable",
+        print(f"FATAL: in-process device bind hung within {timeout_s:.0f}s "
+              "of a healthy probe — tunnel died in the gap",
               file=err, flush=True)
-        os._exit(2)  # the probe thread holds the backend lock — hard exit
+        os._exit(2)  # the bind thread holds the backend lock — hard exit
     if "error" in result:
         print(f"FATAL: device enumeration failed: {result['error']!r}",
               file=err, flush=True)
         raise SystemExit(2)
-    print(f"devices: {result['devices']}", file=err, flush=True)
