@@ -42,7 +42,10 @@ executor selection ``AtomixReplica.java:374``, state machine semantics
 
 from __future__ import annotations
 
+import inspect
+import logging
 from collections import deque
+from contextlib import nullcontext
 from typing import Any, NamedTuple
 
 from ..resource.state_machine import ResourceStateMachine
@@ -50,6 +53,8 @@ from ..server.state_machine import Commit
 from ..atomic import commands as vc
 from ..collections import commands as cc
 from ..coordination import commands as oc
+
+logger = logging.getLogger(__name__)
 
 INT32_MIN = -(2 ** 31)
 INT32_MAX = 2 ** 31 - 1
@@ -72,11 +77,222 @@ class DeviceEngineConfig(NamedTuple):
     same shapes, like ``withStateMachine`` must be uniform in the
     reference)."""
 
-    capacity: int = 16        # device groups = max device-backed resources
+    capacity: int = 1024      # device groups = max device-backed resources
     num_peers: int = 3
     log_slots: int = 64
     submit_slots: int = 4
     seed: int = 0             # shared PRNG seed — same election history
+
+
+class _Job:
+    """One device-op chain (a handler or timer generator) inside a window."""
+
+    __slots__ = ("group", "gen", "settle", "ctx", "on_done", "tag",
+                 "resume_round", "pending", "done", "result", "exc")
+
+    def __init__(self, group: int | None, gen: Any, settle: bool,
+                 ctx: Any = None, on_done: Any = None) -> None:
+        self.group = group
+        self.gen = gen
+        self.settle = settle
+        self.ctx = ctx
+        self.on_done = on_done
+        self.tag: int | None = None
+        self.resume_round: int | None = None
+        self.pending: int | None = None
+        self.done = False
+        self.result: Any = None
+        self.exc: BaseException | None = None
+
+
+class DeviceJob:
+    """A device-backed handler's suspended execution.
+
+    Device command handlers are generator functions — each device op is a
+    ``yield`` — so the applying server can BATCH many handlers' chains into
+    shared engine rounds (:class:`DeviceWindow`) instead of paying
+    submit→commit→settle per op (the round-3 SPI bottleneck). A caller
+    with no window drives the chain alone via :meth:`run`.
+    """
+
+    __slots__ = ("engine", "group", "settle", "gen")
+    is_device_job = True  # duck-typing marker for the applying server
+
+    def __init__(self, engine: "DeviceEngine", group: int, settle: bool,
+                 gen: Any) -> None:
+        self.engine = engine
+        self.group = group
+        self.settle = settle
+        self.gen = gen
+
+    def run(self) -> Any:
+        return self.engine.run_now(self.group, self.gen, self.settle)
+
+
+class DeviceWindow:
+    """Shared round pump for one apply batch.
+
+    Jobs added in CPU-log order are driven concurrently ACROSS device
+    groups and strictly FIFO WITHIN a group: a group's next job starts
+    only when its predecessor finished, so each group's device-op sequence
+    is the concatenation of complete per-handler chains in log order —
+    identical on every server regardless of how commit batches were cut
+    (the determinism requirement of the two-plane design above). One
+    engine round serves every group's current outstanding op, so a batch
+    of K independent handlers costs ~max-chain-length rounds, not
+    sum-of-chains.
+
+    Finalization callbacks (response futures, event seal/push) run in add
+    order — the reference's per-session program-order completion.
+    """
+
+    MAX_ROUNDS = 2000
+
+    def __init__(self, engine: "DeviceEngine") -> None:
+        self._eng = engine
+        self._active: dict[int, _Job] = {}          # group -> running job
+        self._waiting: dict[int, deque[_Job]] = {}  # group -> queued jobs
+        self._order: list[_Job] = []                # finalization order
+        self._finalized = 0
+        #: per-entry context inherited by timer-spawned jobs (the applying
+        #: server sets it around each command entry's tick+execute)
+        self.job_ctx: Any = None
+
+    @property
+    def busy(self) -> bool:
+        return bool(self._active) or self._finalized < len(self._order)
+
+    # -- enqueue -----------------------------------------------------------
+
+    def add_job(self, job: DeviceJob, ctx: Any = None,
+                on_done: Any = None) -> None:
+        """Defer a handler chain; ``on_done(result, exc)`` runs at its
+        log-ordered finalization slot."""
+        self._enqueue(_Job(job.group, job.gen, job.settle, ctx, on_done))
+
+    def add_ready(self, on_done: Any) -> None:
+        """Defer an already-computed completion so it finalizes in log
+        order behind pending device jobs (no-op ordering shim when the
+        window is idle)."""
+        j = _Job(None, None, False, None, on_done)
+        j.done = True
+        self._order.append(j)
+        self._try_finalize()
+
+    def _enqueue(self, j: _Job) -> None:
+        self._order.append(j)
+        if j.group in self._active:
+            self._waiting.setdefault(j.group, deque()).append(j)
+        else:
+            self._active[j.group] = j
+            self._advance(j, None)
+        self._try_finalize()
+
+    # -- drive -------------------------------------------------------------
+
+    def _advance(self, job: _Job, value: Any) -> None:
+        """Resume ``job`` with ``value`` until it suspends on a device op
+        or finishes; iteratively promote waiting jobs of freed groups (a
+        long chain of no-op jobs must not recurse)."""
+        work: list[tuple[_Job, Any]] = [(job, value)]
+        groups = None
+        while work:
+            j, val = work.pop()
+            try:
+                with j.ctx if j.ctx is not None else nullcontext():
+                    yielded = j.gen.send(val)
+            except StopIteration as stop:
+                j.done = True
+                j.result = stop.value
+            except BaseException as e:  # noqa: BLE001 — surfaced at finalize
+                j.done = True
+                j.exc = e
+            if not j.done:
+                if yielded[0] == "cmd":
+                    if groups is None:
+                        groups = self._eng._ensure()
+                    j.tag = groups.submit(j.group, yielded[1], yielded[2],
+                                          yielded[3], yielded[4])
+                    j.resume_round = None
+                    continue
+                # unknown yield: fail THIS job (still freeing its group
+                # below so queued jobs keep running)
+                j.done = True
+                j.exc = RuntimeError(f"unknown device yield {yielded!r}")
+                j.gen.close()
+            del self._active[j.group]
+            q = self._waiting.get(j.group)
+            if q:
+                nxt = q.popleft()
+                if not q:
+                    del self._waiting[j.group]
+                self._active[j.group] = nxt
+                work.append((nxt, None))
+
+    def _collect(self, groups) -> bool:
+        """Resolve finished tags / elapsed settle windows; returns whether
+        any job progressed (False → the pump must step a round)."""
+        progressed = False
+        now = groups.rounds
+        results = groups.results
+        for j in list(self._active.values()):
+            if j.tag is not None and j.tag in results:
+                res = results.pop(j.tag)
+                j.tag = None
+                if j.settle:
+                    # event consumers (lock/election) resume only after
+                    # their op's session events drained to the host buffer
+                    j.pending = res
+                    j.resume_round = now + self._eng.SETTLE_ROUNDS
+                else:
+                    progressed = True
+                    self._advance(j, res)
+            elif (j.tag is None and j.resume_round is not None
+                  and now >= j.resume_round):
+                j.resume_round = None
+                progressed = True
+                self._advance(j, j.pending)
+        return progressed
+
+    def pump(self) -> None:
+        """Drive every pending job to completion, then run finalizations
+        in add order."""
+        if self._active:
+            groups = self._eng._ensure()
+            start = groups.rounds
+            while self._active:
+                if groups.rounds - start > self.MAX_ROUNDS:
+                    raise TimeoutError(
+                        f"device window stuck after {self.MAX_ROUNDS} rounds"
+                        f" without progress (groups {sorted(self._active)})")
+                if self._collect(groups):
+                    # a no-progress watchdog, not a total budget: a long
+                    # FIFO chain on one group is legitimate work
+                    start = groups.rounds
+                elif self._active:
+                    groups.step_round()
+        self._try_finalize()
+
+    barrier = pump  # drain point before entries that read manager state
+
+    def close(self) -> None:
+        try:
+            self.pump()
+        finally:
+            if self._eng._window is self:
+                self._eng._window = None
+
+    def _try_finalize(self) -> None:
+        while self._finalized < len(self._order):
+            j = self._order[self._finalized]
+            if not j.done:
+                break
+            self._finalized += 1
+            if j.on_done is not None:
+                j.on_done(j.result, j.exc)
+            elif j.exc is not None:
+                # timer-spawned chain failed; mirror executor.tick's policy
+                logger.exception("device timer chain failed", exc_info=j.exc)
 
 
 class DeviceEngine:
@@ -95,10 +311,11 @@ class DeviceEngine:
     falls back to the CPU state machine for that resource.
     """
 
-    #: extra rounds stepped after each command so session events emitted by
-    #: the apply (lock grants, election promotions) are drained into the
-    #: host buffer before the handler returns — a fixed, deterministic
-    #: settle budget (events surface one round after the emitting apply).
+    #: extra rounds stepped after a command before an event-consuming
+    #: machine (lock/election) resumes, so session events emitted by the
+    #: apply are drained into the host buffer first — a fixed,
+    #: deterministic settle budget (events surface one round after the
+    #: emitting apply).
     SETTLE_ROUNDS = 2
 
     def __init__(self, config: DeviceEngineConfig | None = None) -> None:
@@ -106,6 +323,7 @@ class DeviceEngine:
         self._groups = None          # built lazily: first device resource
         self._next_group = 0
         self._free: list[int] = []   # released (reset) groups, lowest first
+        self._window: DeviceWindow | None = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -143,15 +361,62 @@ class DeviceEngine:
 
     # -- op plane ----------------------------------------------------------
 
+    def begin_window(self) -> DeviceWindow:
+        """Open the shared round pump for one apply batch (the applying
+        server closes it after the batch's last entry)."""
+        window = DeviceWindow(self)
+        self._window = window
+        return window
+
+    @property
+    def window(self) -> DeviceWindow | None:
+        return self._window
+
+    def run_now(self, group: int, gen: Any, settle: bool = False) -> Any:
+        """Drive one chain to completion on a private pump (the per-op
+        path for callers outside any window)."""
+        w = DeviceWindow(self)
+        job = _Job(group, gen, settle)
+        w._enqueue(job)
+        w.pump()
+        if job.exc is not None:
+            raise job.exc
+        return job.result
+
+    def run_excl(self, group: int, gen: Any, settle: bool = False) -> Any:
+        """Drain the open window (if any), then drive ``gen`` alone — for
+        delete/session-close chains that must observe fully-applied state
+        and complete before the caller proceeds (e.g. group release must
+        precede any later allocate)."""
+        if self._window is not None and self._window.busy:
+            self._window.barrier()
+        return self.run_now(group, gen, settle)
+
+    def spawn(self, group: int, gen: Any, settle: bool = False) -> None:
+        """Timer-fired device work.
+
+        During a COMMAND entry's tick (``window.job_ctx`` set) the chain
+        joins the window at its log-ordered slot — before the entry's own
+        handler job — under the entry's context, so its publishes seal
+        with that entry. Outside a command entry (non-command entries
+        barrier the window first; or no window at all) it runs
+        immediately: the window is empty then, so immediate execution IS
+        the log-ordered slot, and publishes land in the live touched set
+        the current entry seals."""
+        if self._window is not None and self._window.job_ctx is not None:
+            self._window._enqueue(
+                _Job(group, gen, settle, self._window.job_ctx, None))
+        else:
+            self.run_now(group, gen, settle)
+
     def command(self, group: int, opcode: int, a: int = 0, b: int = 0,
                 c: int = 0) -> int:
-        """Submit one committed device op and return its applied result."""
-        groups = self._ensure()
-        tag = groups.submit(group, opcode, a, b, c)
-        groups.run_until([tag])
-        for _ in range(self.SETTLE_ROUNDS):
-            groups.step_round()
-        return groups.results.pop(tag)
+        """Submit one committed device op and return its applied result
+        (standalone per-op path; handlers go through generator chains)."""
+        def one():
+            return (yield ("cmd", int(opcode), int(a), int(b), int(c)))
+
+        return self.run_now(group, one(), settle=True)
 
     def query(self, group: int, opcode: int, a: int = 0, b: int = 0,
               c: int = 0) -> int:
@@ -210,7 +475,23 @@ class _Held:
 
 
 class DeviceBackedStateMachine(ResourceStateMachine):
-    """Base for state machines whose data plane is a device group."""
+    """Base for state machines whose data plane is a device group.
+
+    Command handlers (and every helper that issues device ops) are
+    GENERATOR functions: ``result = yield from self._cmd(...)``. ``init``
+    wraps each registered generator handler so the applying server
+    receives a :class:`DeviceJob` it can batch into the open
+    :class:`DeviceWindow` — the shared round pump — instead of a value.
+    Query handlers stay plain functions (they never append device ops —
+    determinism rule #2) and serve synchronously. Host-state-only command
+    handlers (e.g. value ``listen``) still run as jobs (``yield from ()``)
+    so their host mutations keep log order relative to in-flight chains.
+    """
+
+    #: True for machines that consume device session events (lock grants,
+    #: election promotions): their chains resume only after each op's
+    #: events settle into the host buffer.
+    SETTLES = False
 
     def __init__(self, engine: DeviceEngine, group: int) -> None:
         super().__init__()
@@ -219,8 +500,34 @@ class DeviceBackedStateMachine(ResourceStateMachine):
         # skip events addressed to a predecessor resource of this group
         self._ev_cursor = engine.event_cursor(group)
 
-    def _cmd(self, opcode: int, a: int = 0, b: int = 0, c: int = 0) -> int:
-        return self._eng.command(self._group, opcode, a, b, c)
+    def init(self, executor) -> None:
+        super().init(executor)
+        executor.rewrap(self._wrap_handler)
+
+    def _wrap_handler(self, fn):
+        inner = getattr(fn, "__func__", fn)
+        if not inspect.isgeneratorfunction(inner):
+            return fn
+
+        def wrapped(commit, _fn=fn):
+            return DeviceJob(self._eng, self._group, type(self).SETTLES,
+                             _fn(commit))
+
+        return wrapped
+
+    def _cmd(self, opcode: int, a: int = 0, b: int = 0, c: int = 0):
+        """Issue one device command from inside a chain:
+        ``result = yield from self._cmd(...)``."""
+        result = yield ("cmd", int(opcode), int(a), int(b), int(c))
+        return result
+
+    def _spawn(self, gen) -> None:
+        """Hand a timer-fired device chain to the engine (window-ordered)."""
+        self._eng.spawn(self._group, gen, type(self).SETTLES)
+
+    def _run_excl(self, gen):
+        """Drive a chain to completion now (delete/session-close hooks)."""
+        return self._eng.run_excl(self._group, gen, type(self).SETTLES)
 
     def _qry(self, opcode: int, a: int = 0, b: int = 0, c: int = 0) -> int:
         return self._eng.query(self._group, opcode, a, b, c)
@@ -261,8 +568,7 @@ class DeviceAtomicValueState(DeviceBackedStateMachine):
             return self._qry(ops().OP_VALUE_GET)
         return self._held.value
 
-    def _set_current(self, commit: Commit, value: Any,
-                     ttl: float | None) -> Any:
+    def _set_current(self, commit: Commit, value: Any, ttl: float | None):
         """Install ``value``; returns the previous value. One device
         command at most (GET_AND_SET covers the device→device case)."""
         if self._timer is not None:
@@ -275,30 +581,37 @@ class DeviceAtomicValueState(DeviceBackedStateMachine):
         else:
             previous_host = None
         if _devint(value):
-            previous_dev = self._cmd(ops().OP_VALUE_GET_AND_SET, value)
+            previous_dev = yield from self._cmd(
+                ops().OP_VALUE_GET_AND_SET, value)
             previous = previous_dev if was_device else previous_host
             self._held = _Held(commit, on_device=True)
         else:
             if was_device:
-                previous = self._cmd(ops().OP_VALUE_GET_AND_SET, 0)
+                previous = yield from self._cmd(ops().OP_VALUE_GET_AND_SET, 0)
             else:
                 previous = previous_host
             self._held = _Held(commit, value=value)
         if ttl:
-            held = self._held
-
-            def expire() -> None:
-                if self._held is held:
-                    self._clear_value()
-                    self._publish_change(None)
-
-            self._timer = self.executor.schedule(ttl, expire)
+            self._arm_ttl(ttl)
         return previous
 
-    def _clear_value(self) -> None:
+    def _arm_ttl(self, ttl: float) -> None:
+        held = self._held
+
+        def expire() -> None:  # fires at log time; the chain drives ordered
+            def chain():
+                if self._held is held:
+                    yield from self._clear_value()
+                    self._publish_change(None)
+
+            self._spawn(chain())
+
+        self._timer = self.executor.schedule(ttl, expire)
+
+    def _clear_value(self):
         if self._held is not None:
             if self._held.on_device:
-                self._cmd(ops().OP_VALUE_SET, 0)
+                yield from self._cmd(ops().OP_VALUE_SET, 0)
             self._held.discard()
             self._held = None
         self._timer = None
@@ -313,13 +626,13 @@ class DeviceAtomicValueState(DeviceBackedStateMachine):
 
     def set(self, commit: Commit[vc.Set]) -> None:
         op = commit.operation
-        previous = self._set_current(commit, op.value, op.ttl)
+        previous = yield from self._set_current(commit, op.value, op.ttl)
         if previous != op.value:
             self._publish_change(op.value)
 
     def get_and_set(self, commit: Commit[vc.GetAndSet]) -> Any:
         op = commit.operation
-        previous = self._set_current(commit, op.value, op.ttl)
+        previous = yield from self._set_current(commit, op.value, op.ttl)
         if previous != op.value:
             self._publish_change(op.value)
         return previous
@@ -329,7 +642,8 @@ class DeviceAtomicValueState(DeviceBackedStateMachine):
         if (self._held is not None and self._held.on_device
                 and _devint(op.expect) and _devint(op.update)):
             # single device CAS — the hot path (BASELINE config #1)
-            if self._cmd(ops().OP_VALUE_CAS, op.expect, op.update):
+            if (yield from self._cmd(ops().OP_VALUE_CAS, op.expect,
+                                     op.update)):
                 self._held.discard()
                 self._held = _Held(commit, on_device=True)
                 self._reschedule_ttl(op.ttl)
@@ -339,7 +653,7 @@ class DeviceAtomicValueState(DeviceBackedStateMachine):
             commit.clean()
             return False
         if self._value() == op.expect:
-            self._set_current(commit, op.update, op.ttl)
+            yield from self._set_current(commit, op.update, op.ttl)
             if op.update != op.expect:
                 self._publish_change(op.update)
             return True
@@ -351,24 +665,22 @@ class DeviceAtomicValueState(DeviceBackedStateMachine):
             self._timer.cancel()
             self._timer = None
         if ttl:
-            held = self._held
-
-            def expire() -> None:
-                if self._held is held:
-                    self._clear_value()
-                    self._publish_change(None)
-
-            self._timer = self.executor.schedule(ttl, expire)
+            self._arm_ttl(ttl)
 
     # -- change listeners (same protocol as the CPU machine) ---------------
+    # listen/unlisten are host-state-only but still run as ordered jobs
+    # (``yield from ()``): a later listen must not observe state ahead of
+    # an earlier in-flight set/CAS chain's publish.
 
     def listen(self, commit: Commit[vc.Listen]) -> None:
+        yield from ()
         previous = self._listeners.get(commit.session.id)
         if previous is not None:
             previous.clean()
         self._listeners[commit.session.id] = commit
 
     def unlisten(self, commit: Commit[vc.Unlisten]) -> None:
+        yield from ()
         previous = self._listeners.pop(commit.session.id, None)
         if previous is not None:
             previous.clean()
@@ -385,17 +697,21 @@ class DeviceAtomicValueState(DeviceBackedStateMachine):
             listen_commit.clean()
 
     def delete(self) -> None:
-        if self._timer is not None:
-            self._timer.cancel()
-            self._timer = None
-        if self._held is not None:
-            if self._held.on_device:
-                self._cmd(ops().OP_VALUE_SET, 0)  # reset for group reuse
-            self._held.discard()
-            self._held = None
-        for listen_commit in self._listeners.values():
-            listen_commit.clean()
-        self._listeners.clear()
+        def chain():
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            if self._held is not None:
+                if self._held.on_device:
+                    # reset for group reuse
+                    yield from self._cmd(ops().OP_VALUE_SET, 0)
+                self._held.discard()
+                self._held = None
+            for listen_commit in self._listeners.values():
+                listen_commit.clean()
+            self._listeners.clear()
+
+        self._run_excl(chain())
         super().delete()
 
 
@@ -417,8 +733,7 @@ class DeviceMapState(DeviceBackedStateMachine):
 
     # -- internals ---------------------------------------------------------
 
-    def _store(self, key: Any, value: Any, commit: Commit,
-               ttl: float | None) -> Any:
+    def _store(self, key: Any, value: Any, commit: Commit, ttl: float | None):
         """Insert/overwrite ``key``; returns the previous value."""
         previous_held = self._held.get(key)
         previous = self._read(key)
@@ -429,23 +744,28 @@ class DeviceMapState(DeviceBackedStateMachine):
             on_device = False
         if on_device:
             if _devint(value):
-                self._cmd(ops().OP_MAP_PUT, key, value)
+                yield from self._cmd(ops().OP_MAP_PUT, key, value)
                 held = _Held(commit, on_device=True)
             else:
-                self._cmd(ops().OP_MAP_REMOVE, key)
+                yield from self._cmd(ops().OP_MAP_REMOVE, key)
                 held = _Held(commit, value=value)
         else:
-            if (previous_held is None and _devint(key) and _devint(value)
-                    and self._cmd(ops().OP_MAP_PUT, key, value) != FAIL()):
+            if previous_held is None and _devint(key) and _devint(value):
+                placed = yield from self._cmd(ops().OP_MAP_PUT, key, value)
+            else:
+                placed = FAIL()
+            if placed != FAIL():
                 held = _Held(commit, on_device=True)
             else:
                 held = _Held(commit, value=value)
         self._held[key] = held
         if ttl:
             def expire() -> None:
-                current = self._held.get(key)
-                if current is held:
-                    self._evict(key, held)
+                def chain():
+                    if self._held.get(key) is held:
+                        yield from self._evict(key, held)
+
+                self._spawn(chain())
 
             held.timer = self.executor.schedule(ttl, expire)
         return previous
@@ -458,10 +778,10 @@ class DeviceMapState(DeviceBackedStateMachine):
             return self._qry(ops().OP_MAP_GET, key)
         return held.value
 
-    def _evict(self, key: Any, held: _Held) -> None:
+    def _evict(self, key: Any, held: _Held):
         del self._held[key]
         if held.on_device:
-            self._cmd(ops().OP_MAP_REMOVE, key)
+            yield from self._cmd(ops().OP_MAP_REMOVE, key)
         held.discard()
 
     # -- queries -----------------------------------------------------------
@@ -514,7 +834,7 @@ class DeviceMapState(DeviceBackedStateMachine):
 
     def put(self, commit: Commit[cc.MapPut]) -> Any:
         op = commit.operation
-        return self._store(op.key, op.value, commit, op.ttl)
+        return (yield from self._store(op.key, op.value, commit, op.ttl))
 
     def put_if_absent(self, commit: Commit[cc.MapPutIfAbsent]) -> Any:
         op = commit.operation
@@ -522,7 +842,7 @@ class DeviceMapState(DeviceBackedStateMachine):
             value = self._read(op.key)
             commit.clean()
             return value
-        self._store(op.key, op.value, commit, op.ttl)
+        yield from self._store(op.key, op.value, commit, op.ttl)
         return None
 
     def remove(self, commit: Commit[cc.MapRemove]) -> Any:
@@ -532,7 +852,7 @@ class DeviceMapState(DeviceBackedStateMachine):
         if held is None:
             return None
         value = self._read(key)
-        self._evict(key, held)
+        yield from self._evict(key, held)
         return value
 
     def remove_if_present(self, commit: Commit[cc.MapRemoveIfPresent]) -> bool:
@@ -541,7 +861,7 @@ class DeviceMapState(DeviceBackedStateMachine):
         held = self._held.get(op.key)
         if held is None or self._read(op.key) != op.value:
             return False
-        self._evict(op.key, held)
+        yield from self._evict(op.key, held)
         return True
 
     def replace(self, commit: Commit[cc.MapReplace]) -> Any:
@@ -549,30 +869,34 @@ class DeviceMapState(DeviceBackedStateMachine):
         if op.key not in self._held:
             commit.clean()
             return None
-        return self._store(op.key, op.value, commit, op.ttl)
+        return (yield from self._store(op.key, op.value, commit, op.ttl))
 
     def replace_if_present(self, commit: Commit[cc.MapReplaceIfPresent]) -> bool:
         op = commit.operation
         if op.key not in self._held or self._read(op.key) != op.expect:
             commit.clean()
             return False
-        self._store(op.key, op.value, commit, op.ttl)
+        yield from self._store(op.key, op.value, commit, op.ttl)
         return True
 
     def clear(self, commit: Commit[cc.MapClear]) -> None:
         if any(h.on_device for h in self._held.values()):
-            self._cmd(ops().OP_MAP_CLEAR)
+            yield from self._cmd(ops().OP_MAP_CLEAR)
         for held in self._held.values():
             held.discard()
         self._held.clear()
         commit.clean()
 
     def delete(self) -> None:
-        if any(h.on_device for h in self._held.values()):
-            self._cmd(ops().OP_MAP_CLEAR)  # reset for group reuse
-        for held in self._held.values():
-            held.discard()
-        self._held.clear()
+        def chain():
+            if any(h.on_device for h in self._held.values()):
+                # reset for group reuse
+                yield from self._cmd(ops().OP_MAP_CLEAR)
+            for held in self._held.values():
+                held.discard()
+            self._held.clear()
+
+        self._run_excl(chain())
         super().delete()
 
 
@@ -593,24 +917,30 @@ class DeviceSetState(DeviceBackedStateMachine):
         if op.value in self._held:
             commit.clean()
             return False
-        if _devint(op.value) and self._cmd(
-                ops().OP_SET_ADD, op.value) not in (FAIL(), 0):
+        if _devint(op.value):
+            added = yield from self._cmd(ops().OP_SET_ADD, op.value)
+        else:
+            added = FAIL()
+        if added not in (FAIL(), 0):
             held = _Held(commit, on_device=True)
         else:
             held = _Held(commit, value=op.value)
         self._held[op.value] = held
         if op.ttl:
             def expire() -> None:
-                if self._held.get(op.value) is held:
-                    self._evict(op.value, held)
+                def chain():
+                    if self._held.get(op.value) is held:
+                        yield from self._evict(op.value, held)
+
+                self._spawn(chain())
 
             held.timer = self.executor.schedule(op.ttl, expire)
         return True
 
-    def _evict(self, value: Any, held: _Held) -> None:
+    def _evict(self, value: Any, held: _Held):
         del self._held[value]
         if held.on_device:
-            self._cmd(ops().OP_SET_REMOVE, value)
+            yield from self._cmd(ops().OP_SET_REMOVE, value)
         held.discard()
 
     def remove(self, commit: Commit[cc.SetRemove]) -> bool:
@@ -618,7 +948,7 @@ class DeviceSetState(DeviceBackedStateMachine):
         held = self._held.get(commit.operation.value)
         if held is None:
             return False
-        self._evict(commit.operation.value, held)
+        yield from self._evict(commit.operation.value, held)
         return True
 
     def contains(self, commit: Commit[cc.SetContains]) -> bool:
@@ -641,18 +971,22 @@ class DeviceSetState(DeviceBackedStateMachine):
 
     def clear(self, commit: Commit[cc.SetClear]) -> None:
         if any(h.on_device for h in self._held.values()):
-            self._cmd(ops().OP_SET_CLEAR)
+            yield from self._cmd(ops().OP_SET_CLEAR)
         for held in self._held.values():
             held.discard()
         self._held.clear()
         commit.clean()
 
     def delete(self) -> None:
-        if any(h.on_device for h in self._held.values()):
-            self._cmd(ops().OP_SET_CLEAR)  # reset for group reuse
-        for held in self._held.values():
-            held.discard()
-        self._held.clear()
+        def chain():
+            if any(h.on_device for h in self._held.values()):
+                # reset for group reuse
+                yield from self._cmd(ops().OP_SET_CLEAR)
+            for held in self._held.values():
+                held.discard()
+            self._held.clear()
+
+        self._run_excl(chain())
         super().delete()
 
 
@@ -668,10 +1002,10 @@ class DeviceMultiMapState(DeviceBackedStateMachine):
         # (key, value) -> _Held; on_device=True ⇒ pair lives on device
         self._held: dict[tuple, _Held] = {}
 
-    def _evict(self, pair: tuple, held: _Held) -> None:
+    def _evict(self, pair: tuple, held: _Held):
         del self._held[pair]
         if held.on_device:
-            self._cmd(ops().OP_MM_REMOVE_ENTRY, pair[0], pair[1])
+            yield from self._cmd(ops().OP_MM_REMOVE_ENTRY, pair[0], pair[1])
         held.discard()
 
     def put(self, commit: Commit[cc.MultiMapPut]) -> bool:
@@ -680,17 +1014,22 @@ class DeviceMultiMapState(DeviceBackedStateMachine):
         if pair in self._held:
             commit.clean()
             return False
-        if (_devint(op.key) and _devint(op.value)
-                and self._cmd(ops().OP_MM_PUT, op.key,
-                              op.value) not in (FAIL(), 0)):
+        if _devint(op.key) and _devint(op.value):
+            placed = yield from self._cmd(ops().OP_MM_PUT, op.key, op.value)
+        else:
+            placed = FAIL()
+        if placed not in (FAIL(), 0):
             held = _Held(commit, on_device=True)
         else:
             held = _Held(commit)
         self._held[pair] = held
         if op.ttl:
             def expire() -> None:
-                if self._held.get(pair) is held:
-                    self._evict(pair, held)
+                def chain():
+                    if self._held.get(pair) is held:
+                        yield from self._evict(pair, held)
+
+                self._spawn(chain())
 
             held.timer = self.executor.schedule(op.ttl, expire)
         return True
@@ -707,7 +1046,8 @@ class DeviceMultiMapState(DeviceBackedStateMachine):
         commit.clean()
         pairs = [p for p in self._held if p[0] == key]
         if any(self._held[p].on_device for p in pairs):
-            self._cmd(ops().OP_MM_REMOVE, key)  # drops every device pair
+            # drops every device pair
+            yield from self._cmd(ops().OP_MM_REMOVE, key)
         out = []
         for pair in pairs:
             held = self._held.pop(pair)
@@ -721,7 +1061,7 @@ class DeviceMultiMapState(DeviceBackedStateMachine):
         held = self._held.get((op.key, op.value))
         if held is None:
             return False
-        self._evict((op.key, op.value), held)
+        yield from self._evict((op.key, op.value), held)
         return True
 
     def contains_key(self, commit: Commit[cc.MultiMapContainsKey]) -> bool:
@@ -765,18 +1105,22 @@ class DeviceMultiMapState(DeviceBackedStateMachine):
 
     def clear(self, commit: Commit[cc.MultiMapClear]) -> None:
         if any(h.on_device for h in self._held.values()):
-            self._cmd(ops().OP_MM_CLEAR)
+            yield from self._cmd(ops().OP_MM_CLEAR)
         for held in self._held.values():
             held.discard()
         self._held.clear()
         commit.clean()
 
     def delete(self) -> None:
-        if any(h.on_device for h in self._held.values()):
-            self._cmd(ops().OP_MM_CLEAR)  # reset for group reuse
-        for held in self._held.values():
-            held.discard()
-        self._held.clear()
+        def chain():
+            if any(h.on_device for h in self._held.values()):
+                # reset for group reuse
+                yield from self._cmd(ops().OP_MM_CLEAR)
+            for held in self._held.values():
+                held.discard()
+            self._held.clear()
+
+        self._run_excl(chain())
         super().delete()
 
 
@@ -800,29 +1144,33 @@ class DeviceQueueState(DeviceBackedStateMachine):
         super().__init__(engine, group)
         self._queue: deque[_Held] = deque()  # live entries, global FIFO
 
-    def _enqueue(self, commit: Commit, value: Any) -> bool:
-        if _devint(value) and self._cmd(ops().OP_Q_OFFER, value) == 1:
+    def _enqueue(self, commit: Commit, value: Any):
+        if _devint(value):
+            offered = yield from self._cmd(ops().OP_Q_OFFER, value)
+        else:
+            offered = 0
+        if offered == 1:
             held = _Held(commit, value=value, on_device=True)
         else:
             held = _Held(commit, value=value)
         self._queue.append(held)
         return True
 
-    def _device_poll(self) -> int:
-        return self._cmd(ops().OP_Q_POLL)
+    def _device_poll(self):
+        return (yield from self._cmd(ops().OP_Q_POLL))
 
-    def _pop_head(self) -> _Held:
+    def _pop_head(self):
         held = self._queue.popleft()
         if held.on_device:
-            self._device_poll()
+            yield from self._device_poll()
         held.discard()
         return held
 
     def add(self, commit: Commit[cc.QueueAdd]) -> bool:
-        return self._enqueue(commit, commit.operation.value)
+        return (yield from self._enqueue(commit, commit.operation.value))
 
     def offer(self, commit: Commit[cc.QueueOffer]) -> bool:
-        return self._enqueue(commit, commit.operation.value)
+        return (yield from self._enqueue(commit, commit.operation.value))
 
     def peek(self, commit: Commit[cc.QueuePeek]) -> Any:
         try:
@@ -834,9 +1182,11 @@ class DeviceQueueState(DeviceBackedStateMachine):
         commit.clean()
         if not self._queue:
             return None
-        return self._pop_head().value
+        held = yield from self._pop_head()
+        return held.value
 
     def element(self, commit: Commit[cc.QueueElement]) -> Any:
+        yield from ()
         commit.clean()
         if not self._queue:
             raise ValueError("queue is empty")
@@ -848,31 +1198,32 @@ class DeviceQueueState(DeviceBackedStateMachine):
         if op.value is None:
             if not self._queue:
                 raise ValueError("queue is empty")
-            return self._pop_head().value
+            held = yield from self._pop_head()
+            return held.value
         for held in self._queue:
             if held.value == op.value:
                 if held is self._queue[0]:
-                    self._pop_head()
+                    yield from self._pop_head()
                 else:
                     # mid-queue: tombstone; the device copy (if any) is
                     # drained when it reaches the ring head
                     self._queue.remove(held)
                     if held.on_device:
-                        self._tombstone_device(held)
+                        yield from self._tombstone_device(held)
                     held.discard()
                 return True
         return False
 
-    def _tombstone_device(self, held: _Held) -> None:
+    def _tombstone_device(self, held: _Held):
         # Re-synchronize the ring with the live deque: device entries
         # before this one are still live; we pop-and-reoffer the ring so
         # the removed payload is dropped. Device ring order == order of
         # on_device entries in self._queue, so draining/refilling keeps it.
         live_device = [h.value for h in self._queue if h.on_device]
-        while self._device_poll() != FAIL():
+        while (yield from self._device_poll()) != FAIL():
             pass
         for v in live_device:
-            self._cmd(ops().OP_Q_OFFER, v)
+            yield from self._cmd(ops().OP_Q_OFFER, v)
 
     def contains(self, commit: Commit[cc.QueueContains]) -> bool:
         try:
@@ -895,18 +1246,22 @@ class DeviceQueueState(DeviceBackedStateMachine):
 
     def clear(self, commit: Commit[cc.QueueClear]) -> None:
         if any(h.on_device for h in self._queue):
-            self._cmd(ops().OP_Q_CLEAR)
+            yield from self._cmd(ops().OP_Q_CLEAR)
         for held in self._queue:
             held.discard()
         self._queue.clear()
         commit.clean()
 
     def delete(self) -> None:
-        if any(h.on_device for h in self._queue):
-            self._cmd(ops().OP_Q_CLEAR)  # reset for group reuse
-        for held in self._queue:
-            held.discard()
-        self._queue.clear()
+        def chain():
+            if any(h.on_device for h in self._queue):
+                # reset for group reuse
+                yield from self._cmd(ops().OP_Q_CLEAR)
+            for held in self._queue:
+                held.discard()
+            self._queue.clear()
+
+        self._run_excl(chain())
         super().delete()
 
 
@@ -926,6 +1281,8 @@ class DeviceLockState(DeviceBackedStateMachine):
     CPU machine (``coordination/state.py:21-23``).
     """
 
+    SETTLES = True  # grants arrive as device events; chains resume settled
+
     def __init__(self, engine: DeviceEngine, group: int) -> None:
         super().__init__(engine, group)
         self._waiters: dict[int, Commit] = {}   # waiter id -> Lock commit
@@ -935,7 +1292,7 @@ class DeviceLockState(DeviceBackedStateMachine):
 
     # -- event pump --------------------------------------------------------
 
-    def _pump(self) -> None:
+    def _pump(self):
         for _seq, code, target, _arg in self._events():
             if code != ops().EV_LOCK_GRANT:
                 continue
@@ -943,7 +1300,7 @@ class DeviceLockState(DeviceBackedStateMachine):
             if waiter is None:
                 # grant to a dead waiter (cancelled/closed): release it so
                 # the queue keeps moving
-                self._cmd(ops().OP_LOCK_RELEASE, target)
+                yield from self._cmd(ops().OP_LOCK_RELEASE, target)
                 continue
             self._holder_id = target
             timer = self._timers.pop(target, None)
@@ -952,15 +1309,15 @@ class DeviceLockState(DeviceBackedStateMachine):
             if waiter.session.is_open:
                 waiter.session.publish(
                     "lock", {"id": target, "acquired": True})
-        self._flush_overflow()
+        yield from self._flush_overflow()
 
-    def _flush_overflow(self) -> None:
+    def _flush_overflow(self):
         while self._overflow:
             wid = self._overflow[0]
             if wid not in self._waiters:
                 self._overflow.popleft()
                 continue
-            result = self._cmd(ops().OP_LOCK_ACQUIRE, wid, -1)
+            result = yield from self._cmd(ops().OP_LOCK_ACQUIRE, wid, -1)
             if result == 1:  # granted immediately
                 self._overflow.popleft()
                 self._on_grant(wid)
@@ -983,9 +1340,9 @@ class DeviceLockState(DeviceBackedStateMachine):
     def lock(self, commit: Commit[oc.Lock]) -> int:
         wid = commit.index
         timeout = commit.operation.timeout
-        self._pump()
+        yield from self._pump()
         if timeout == 0:
-            result = self._cmd(ops().OP_LOCK_ACQUIRE, wid, 0)
+            result = yield from self._cmd(ops().OP_LOCK_ACQUIRE, wid, 0)
             if result == 1:
                 self._waiters[wid] = commit
                 self._on_grant(wid)
@@ -993,27 +1350,30 @@ class DeviceLockState(DeviceBackedStateMachine):
                 commit.session.publish(
                     "lock", {"id": wid, "acquired": False})
                 commit.clean()
-            self._pump()
+            yield from self._pump()
             return wid
         self._waiters[wid] = commit
         if self._overflow:
             self._overflow.append(wid)  # preserve FIFO behind overflow
         else:
-            result = self._cmd(ops().OP_LOCK_ACQUIRE, wid, -1)
+            result = yield from self._cmd(ops().OP_LOCK_ACQUIRE, wid, -1)
             if result == 1:
                 self._on_grant(wid)
             elif result == 0:  # device wait ring full — host absorbs
                 self._overflow.append(wid)
         if timeout and timeout > 0 and self._holder_id != wid:
             def expire() -> None:
-                self._timers.pop(wid, None)
-                self._cancel_waiter(wid, publish=True)
+                def chain():
+                    self._timers.pop(wid, None)
+                    yield from self._cancel_waiter(wid, publish=True)
+
+                self._spawn(chain())
 
             self._timers[wid] = self.executor.schedule(timeout, expire)
-        self._pump()
+        yield from self._pump()
         return wid
 
-    def _cancel_waiter(self, wid: int, publish: bool) -> None:
+    def _cancel_waiter(self, wid: int, publish: bool):
         waiter = self._waiters.get(wid)
         if waiter is None or self._holder_id == wid:
             return
@@ -1021,67 +1381,74 @@ class DeviceLockState(DeviceBackedStateMachine):
             self._overflow.remove(wid)
             outcome = 1
         else:
-            outcome = self._cmd(ops().OP_LOCK_CANCEL, wid)
+            outcome = yield from self._cmd(ops().OP_LOCK_CANCEL, wid)
         if outcome == 2:
             # race resolved in our favor: already granted — the grant
             # event is (or will be) in the pump
-            self._pump()
+            yield from self._pump()
             return
         del self._waiters[wid]
         if publish and waiter.session.is_open:
             waiter.session.publish("lock", {"id": wid, "acquired": False})
         waiter.clean()
-        self._pump()
+        yield from self._pump()
 
     def unlock(self, commit: Commit[oc.Unlock]) -> None:
         try:
-            self._pump()
+            yield from self._pump()
             if self._holder_id is None:
                 return
             holder = self._waiters.get(self._holder_id)
             if holder is None or holder.session.id != commit.session.id:
                 raise ValueError("not the lock holder")
-            self._release_holder()
+            yield from self._release_holder()
         finally:
             commit.clean()
 
-    def _release_holder(self) -> None:
+    def _release_holder(self):
         wid = self._holder_id
         holder = self._waiters.pop(wid, None)
         self._holder_id = None
         if holder is not None:
             holder.clean()
-        self._cmd(ops().OP_LOCK_RELEASE, wid)
-        self._pump()
+        yield from self._cmd(ops().OP_LOCK_RELEASE, wid)
+        yield from self._pump()
 
     # -- session lifecycle -------------------------------------------------
 
     def close(self, session: Any) -> None:
-        self._pump()
-        for wid in [w for w, c in self._waiters.items()
-                    if c.session.id == session.id and w != self._holder_id]:
-            self._cancel_waiter(wid, publish=False)
-        if self._holder_id is not None:
-            holder = self._waiters.get(self._holder_id)
-            if holder is not None and holder.session.id == session.id:
-                self._release_holder()
+        def chain():
+            yield from self._pump()
+            for wid in [w for w, c in self._waiters.items()
+                        if c.session.id == session.id
+                        and w != self._holder_id]:
+                yield from self._cancel_waiter(wid, publish=False)
+            if self._holder_id is not None:
+                holder = self._waiters.get(self._holder_id)
+                if holder is not None and holder.session.id == session.id:
+                    yield from self._release_holder()
+
+        self._run_excl(chain())
 
     def delete(self) -> None:
-        for timer in self._timers.values():
-            timer.cancel()
-        self._timers.clear()
-        # Reset the device lock for group reuse: dequeue every waiter
-        # FIRST so releasing the holder cannot grant one of them.
-        for wid in list(self._waiters):
-            if wid != self._holder_id and wid not in self._overflow:
-                self._cmd(ops().OP_LOCK_CANCEL, wid)
-        if self._holder_id is not None:
-            self._cmd(ops().OP_LOCK_RELEASE, self._holder_id)
-            self._holder_id = None
-        for waiter in self._waiters.values():
-            waiter.clean()
-        self._waiters.clear()
-        self._overflow.clear()
+        def chain():
+            for timer in self._timers.values():
+                timer.cancel()
+            self._timers.clear()
+            # Reset the device lock for group reuse: dequeue every waiter
+            # FIRST so releasing the holder cannot grant one of them.
+            for wid in list(self._waiters):
+                if wid != self._holder_id and wid not in self._overflow:
+                    yield from self._cmd(ops().OP_LOCK_CANCEL, wid)
+            if self._holder_id is not None:
+                yield from self._cmd(ops().OP_LOCK_RELEASE, self._holder_id)
+                self._holder_id = None
+            for waiter in self._waiters.values():
+                waiter.clean()
+            self._waiters.clear()
+            self._overflow.clear()
+
+        self._run_excl(chain())
         super().delete()
 
 
@@ -1096,6 +1463,8 @@ class DeviceLeaderElectionState(DeviceBackedStateMachine):
     client, exactly as the reference's commit-index epoch,
     ``LeaderElectionState.java:31``)."""
 
+    SETTLES = True  # promotions arrive as device events
+
     def __init__(self, engine: DeviceEngine, group: int) -> None:
         super().__init__(engine, group)
         self._listens: dict[int, Commit] = {}   # session id -> Listen commit
@@ -1103,27 +1472,27 @@ class DeviceLeaderElectionState(DeviceBackedStateMachine):
         self._epoch: int | None = None
         self._overflow: deque[int] = deque()
 
-    def _pump(self) -> None:
+    def _pump(self):
         for _seq, code, target, arg in self._events():
             if code != ops().EV_ELECT:
                 continue
             listen = self._listens.get(target)
             if listen is None:
                 # promoted a dead candidate: resign it to move succession
-                self._cmd(ops().OP_ELECT_RESIGN, target)
+                yield from self._cmd(ops().OP_ELECT_RESIGN, target)
                 continue
             self._leader, self._epoch = target, arg
             if listen.session.is_open:
                 listen.session.publish("elect", arg)
-        self._flush_overflow()
+        yield from self._flush_overflow()
 
-    def _flush_overflow(self) -> None:
+    def _flush_overflow(self):
         while self._overflow:
             sid = self._overflow[0]
             if sid not in self._listens:
                 self._overflow.popleft()
                 continue
-            result = self._cmd(ops().OP_ELECT_LISTEN, sid)
+            result = yield from self._cmd(ops().OP_ELECT_LISTEN, sid)
             if result == FAIL():
                 break  # listener ring still full
             self._overflow.popleft()
@@ -1138,27 +1507,27 @@ class DeviceLeaderElectionState(DeviceBackedStateMachine):
 
     def listen(self, commit: Commit[oc.ElectionListen]) -> None:
         sid = commit.session.id
-        self._pump()
+        yield from self._pump()
         previous = self._listens.get(sid)
         if previous is not None:
             previous.clean()
             self._listens[sid] = commit
-            self._pump()
+            yield from self._pump()
             return
         self._listens[sid] = commit
         if self._overflow:
             self._overflow.append(sid)
         else:
-            result = self._cmd(ops().OP_ELECT_LISTEN, sid)
+            result = yield from self._cmd(ops().OP_ELECT_LISTEN, sid)
             if result == FAIL():
                 self._overflow.append(sid)  # host absorbs ring overflow
             elif result > 0:
                 self._on_elected(sid, result)
-        self._pump()
+        yield from self._pump()
 
     def unlisten(self, commit: Commit[oc.ElectionUnlisten]) -> None:
         try:
-            self._resign(commit.session.id)
+            yield from self._resign(commit.session.id)
         finally:
             commit.clean()
 
@@ -1175,8 +1544,8 @@ class DeviceLeaderElectionState(DeviceBackedStateMachine):
         finally:
             commit.close()
 
-    def _resign(self, sid: int) -> None:
-        self._pump()
+    def _resign(self, sid: int):
+        yield from self._pump()
         listen = self._listens.pop(sid, None)
         if listen is None:
             return
@@ -1184,27 +1553,31 @@ class DeviceLeaderElectionState(DeviceBackedStateMachine):
         if sid in self._overflow:
             self._overflow.remove(sid)
         else:
-            self._cmd(ops().OP_ELECT_RESIGN, sid)
+            yield from self._cmd(ops().OP_ELECT_RESIGN, sid)
         if self._leader == sid:
             self._leader = self._epoch = None
-        self._pump()
+        yield from self._pump()
 
     def close(self, session: Any) -> None:
-        self._resign(session.id)
+        self._run_excl(self._resign(session.id))
 
     def delete(self) -> None:
-        # Reset the device election for group reuse: unlist waiters first,
-        # resign the leader last (empty ring → no succession event).
-        for sid in list(self._listens):
-            if sid != self._leader and sid not in self._overflow:
-                self._cmd(ops().OP_ELECT_RESIGN, sid)
-        if self._leader is not None:
-            self._cmd(ops().OP_ELECT_RESIGN, self._leader)
-            self._leader = self._epoch = None
-        for listen in self._listens.values():
-            listen.clean()
-        self._listens.clear()
-        self._overflow.clear()
+        def chain():
+            # Reset the device election for group reuse: unlist waiters
+            # first, resign the leader last (empty ring → no succession
+            # event).
+            for sid in list(self._listens):
+                if sid != self._leader and sid not in self._overflow:
+                    yield from self._cmd(ops().OP_ELECT_RESIGN, sid)
+            if self._leader is not None:
+                yield from self._cmd(ops().OP_ELECT_RESIGN, self._leader)
+                self._leader = self._epoch = None
+            for listen in self._listens.values():
+                listen.clean()
+            self._listens.clear()
+            self._overflow.clear()
+
+        self._run_excl(chain())
         super().delete()
 
 

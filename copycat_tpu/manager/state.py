@@ -180,6 +180,15 @@ class ResourceManager(StateMachine):
         if self.executor_kind == "tpu":
             self.device_engine._ensure()
 
+    def begin_window(self) -> Any:
+        """Open a shared device round pump for one apply batch (``None``
+        on the CPU executor). The applying server defers device-backed
+        handler chains into it so a batch of committed entries shares
+        engine rounds instead of paying submit→commit→settle per op."""
+        if self.executor_kind != "tpu":
+            return None
+        return self.device_engine.begin_window()
+
     # -- catalog ops -------------------------------------------------------
 
     def get_resource(self, commit: Commit[GetResource]) -> int:

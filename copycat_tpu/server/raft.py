@@ -48,6 +48,56 @@ FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 logger = logging.getLogger(__name__)
 
 
+class _EntryCtx:
+    """Per-entry execution context for windowed applies.
+
+    While entered, session publishes are buffered (replayed in log order
+    at the entry's finalization) and the executor context's clock/index
+    are pinned to the ENTRY's values — a deferred chain resumes after
+    later entries advanced the clock, and timers it schedules must use the
+    entry's log time on every server or TTL firing order would diverge
+    between replicas with different commit-batch boundaries.
+    """
+
+    __slots__ = ("raft", "index", "clock", "touched", "buffer",
+                 "_prev_touched", "_prev_buffer", "_prev_index",
+                 "_prev_clock")
+
+    def __init__(self, raft: "RaftServer", entry: Entry) -> None:
+        self.raft = raft
+        self.index = entry.index
+        # _apply_entry already advanced context.clock to this entry
+        self.clock = raft.context.clock
+        self.touched: set = set()
+        self.buffer: list = []
+
+    def __enter__(self) -> "_EntryCtx":
+        r = self.raft
+        self._prev_touched = r._touched_sessions
+        self._prev_buffer = r._publish_buffer
+        self._prev_index = r.context.index
+        self._prev_clock = r.context.clock
+        r._touched_sessions = self.touched
+        r._publish_buffer = self.buffer
+        r.context.index = self.index
+        r.context.clock = self.clock
+        return self
+
+    def __exit__(self, *exc) -> None:
+        r = self.raft
+        r._touched_sessions = self._prev_touched
+        r._publish_buffer = self._prev_buffer
+        r.context.index = self._prev_index
+        r.context.clock = self._prev_clock
+
+    def replay(self) -> None:
+        """Flush buffered publishes into the session event queues."""
+        for orig, event, message, session in self.buffer:
+            orig(event, message)
+            self.touched.add(session)
+        self.buffer.clear()
+
+
 class RaftServer(Managed):
     """A single Raft replica hosting one top-level state machine."""
 
@@ -108,6 +158,13 @@ class RaftServer(Managed):
         self._commit_futures: dict[int, asyncio.Future] = {}  # index -> (result, error)
         self._touched_sessions: set[ServerSession] = set()
         self._applied_event = asyncio.Event()  # pulsed on every apply advance
+        # windowed apply (device executor): publishes buffered per entry so
+        # event order matches log order even when handler chains complete
+        # out of order; (session, seq) pairs of deferred commands guard
+        # exactly-once against a duplicate landing in the same batch
+        self._publish_buffer: list | None = None
+        self._window_pending_seqs: set[tuple[int, int]] = set()
+        self._advance_scheduled = False  # single-member deferred commit
 
         self._server = transport.server()
         self._client = transport.client()
@@ -360,8 +417,20 @@ class RaftServer(Managed):
         index = self.log.append(entry)
         self._signal_replication()
         if len(self.members) == 1:
-            self._advance_commit()
+            # Defer commit advance to the end of the current event-loop
+            # turn so a burst of concurrent appends commits and APPLIES as
+            # one batch (the device window amortizes engine rounds across
+            # the whole batch; multi-member clusters batch naturally via
+            # replication acks).
+            if not self._advance_scheduled:
+                self._advance_scheduled = True
+                asyncio.get_running_loop().call_soon(self._advance_deferred)
         return index
+
+    def _advance_deferred(self) -> None:
+        self._advance_scheduled = False
+        if self.role == LEADER and not self._closing:
+            self._advance_commit()
 
     def _signal_replication(self) -> None:
         for event in self._replication_events.values():
@@ -369,8 +438,8 @@ class RaftServer(Managed):
 
     async def _append_and_wait(self, entry: Entry) -> Any:
         """Append an entry and wait until it is committed and applied."""
-        # Register the future before appending: on a single-member cluster the
-        # append commits and applies synchronously.
+        # Register the future before appending: on a single-member cluster
+        # the append commits and applies within the same event-loop turn.
         index = self.log.last_index + 1
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._commit_futures[index] = fut
@@ -805,20 +874,41 @@ class RaftServer(Managed):
     # ------------------------------------------------------------------
 
     def _apply_up_to(self, commit_index: int) -> None:
-        while self.last_applied < commit_index:
-            index = self.last_applied + 1
-            entry = self.log.get(index)
-            self.last_applied = index
-            if entry is not None:
+        window = None
+        if self.last_applied < commit_index:
+            begin = getattr(self.state_machine, "begin_window", None)
+            if begin is not None:
+                window = begin()  # None on the CPU executor
+        try:
+            while self.last_applied < commit_index:
+                index = self.last_applied + 1
+                entry = self.log.get(index)
+                self.last_applied = index
+                if entry is not None:
+                    try:
+                        self._apply_entry(entry, window)
+                    except Exception:
+                        logger.exception("apply failed at index %d", index)
+        finally:
+            if window is not None:
                 try:
-                    self._apply_entry(entry)
+                    window.close()
                 except Exception:
-                    logger.exception("apply failed at index %d", index)
+                    logger.exception("device window close failed")
         self._applied_event.set()
 
-    def _apply_entry(self, entry: Entry) -> None:
+    def _apply_entry(self, entry: Entry, window: Any = None) -> None:
+        if (window is not None and window.busy
+                and not isinstance(entry, CommandEntry)):
+            # Session/config/noop entries read state that in-flight device
+            # chains may still mutate — drain the window to stay aligned
+            # with the log on every server.
+            window.barrier()
         self.context.index = entry.index
         self.context.clock = max(self.context.clock, entry.timestamp)
+        if window is not None and isinstance(entry, CommandEntry):
+            self._apply_command_windowed(entry, window)
+            return
         # Reset BEFORE ticking: timer callbacks publish session events too, and
         # those must be sealed/pushed with this entry.
         self._touched_sessions = set()
@@ -833,26 +923,74 @@ class RaftServer(Managed):
         elif isinstance(entry, UnregisterEntry):
             self._apply_unregister(entry)
         elif isinstance(entry, CommandEntry):
-            result, error = self._apply_command(entry)
+            result, error, _ = self._apply_command(entry)
         elif isinstance(entry, ConfigurationEntry):
             self._apply_configuration(entry)
         elif isinstance(entry, NoOpEntry):
             self.log.clean(entry.index)
 
         # Seal + push session events produced by this entry.
-        pushes: list[asyncio.Task] = []
-        for session in self._touched_sessions:
-            batch = session.commit_events()
-            if batch is not None and self.role == LEADER:
-                task = self._push_events(session)
-                if task is not None:
-                    pushes.append(task)
+        pushes = self._seal_and_push(self._touched_sessions)
 
         fut = self._commit_futures.pop(entry.index, None)
         if fut is not None and not fut.done():
             fut.set_result((entry.index, result, error))
         if isinstance(entry, CommandEntry):
             self._complete_command(entry, result, error, pushes)
+
+    def _seal_and_push(self, touched) -> list[asyncio.Task]:
+        pushes: list[asyncio.Task] = []
+        for session in touched:
+            batch = session.commit_events()
+            if batch is not None and self.role == LEADER:
+                task = self._push_events(session)
+                if task is not None:
+                    pushes.append(task)
+        return pushes
+
+    # -- windowed apply (device executor) ------------------------------
+
+    def _apply_command_windowed(self, entry: CommandEntry, window: Any) -> None:
+        """Apply one command entry under the device window: the handler may
+        return a suspended device-op chain (DeviceJob) that is deferred
+        into the shared round pump; its finalization (response cache,
+        event seal/push, futures) runs at the entry's log-ordered slot."""
+        ctx = _EntryCtx(self, entry)
+        window.job_ctx = ctx  # timer chains spawned by tick inherit it
+        try:
+            with ctx:
+                self.executor.tick(self.context.clock)
+                result, error, job = self._apply_command(entry, window)
+        finally:
+            window.job_ctx = None
+        if job is not None:
+            window.add_job(job, ctx=ctx, on_done=lambda res, exc:
+                           self._finalize_deferred(entry, res, exc, ctx))
+        else:
+            window.add_ready(lambda res, exc:
+                             self._finalize_entry(entry, result, error, ctx))
+
+    def _finalize_deferred(self, entry: CommandEntry, result: Any,
+                           exc: BaseException | None, ctx: "_EntryCtx") -> None:
+        error: str | None = None
+        if exc is not None:
+            result, error = None, str(exc)
+            self.log.clean(entry.index)
+        if entry.seq:
+            self._window_pending_seqs.discard((entry.session_id, entry.seq))
+            session = self.sessions.get(entry.session_id)
+            if session is not None:
+                session.cache_response(entry.seq, entry.index, result, error)
+        self._finalize_entry(entry, result, error, ctx)
+
+    def _finalize_entry(self, entry: CommandEntry, result: Any,
+                        error: str | None, ctx: "_EntryCtx") -> None:
+        ctx.replay()  # buffered publishes land in log order
+        pushes = self._seal_and_push(ctx.touched)
+        fut = self._commit_futures.pop(entry.index, None)
+        if fut is not None and not fut.done():
+            fut.set_result((entry.index, result, error))
+        self._complete_command(entry, result, error, pushes)
 
     def _session_touched(self, session: ServerSession) -> None:
         self._touched_sessions.add(session)
@@ -865,8 +1003,14 @@ class RaftServer(Managed):
 
         def tracked_publish(event: str, message: Any = None,
                             _orig=original_publish, _s=session) -> None:
-            _orig(event, message)
-            self._session_touched(_s)
+            buf = self._publish_buffer
+            if buf is not None:
+                # windowed apply: buffered, replayed in log order at the
+                # entry's finalization (chains complete out of order)
+                buf.append((_orig, event, message, _s))
+            else:
+                _orig(event, message)
+                self._session_touched(_s)
 
         session.publish = tracked_publish  # type: ignore[method-assign]
         self.sessions[entry.index] = session
@@ -899,21 +1043,32 @@ class RaftServer(Managed):
         session.state = SessionState.EXPIRED if entry.expired else SessionState.CLOSED
         self.log.clean(entry.index)
 
-    def _apply_command(self, entry: CommandEntry) -> tuple[Any, str | None]:
+    def _apply_command(self, entry: CommandEntry,
+                       window: Any = None) -> tuple[Any, str | None, Any]:
+        """Apply one command; returns ``(result, error, deferred_job)``.
+
+        ``deferred_job`` is non-None only under an open device window, when
+        the handler returned a suspended device-op chain: the caller owns
+        its response caching and completion (``_finalize_deferred``)."""
         session = self.sessions.get(entry.session_id)
         if session is None or session.state is not SessionState.OPEN:
             self.log.clean(entry.index)
-            return None, "session expired or unknown"
+            return None, "session expired or unknown", None
+        if (entry.seq and window is not None
+                and (entry.session_id, entry.seq) in self._window_pending_seqs):
+            # duplicate of a command still in flight in this window: settle
+            # it first so the cached-response dedup below sees it
+            window.barrier()
         if entry.seq and entry.seq <= session.command_high:
             cached = session.cached_response(entry.seq)
             if cached is not None:
                 _, result, error = cached
-                return result, error
+                return result, error, None
             # Duplicate append whose cached response was already pruned; the
             # original apply completed any pending future, so this error result
             # is only ever seen if something is deeply wrong — never a silent
             # success for a skipped write.
-            return None, f"duplicate command seq {entry.seq} (response pruned)"
+            return None, f"duplicate command seq {entry.seq} (response pruned)", None
         session.last_keepalive_time = self.context.clock
         commit = Commit(entry.index, session, self.context.clock, entry.operation, self.log)
         try:
@@ -921,9 +1076,22 @@ class RaftServer(Managed):
         except Exception as e:  # noqa: BLE001
             result, error = None, str(e)
             self.log.clean(entry.index)
+        if getattr(result, "is_device_job", False):
+            if window is not None:
+                if entry.seq:
+                    self._window_pending_seqs.add(
+                        (entry.session_id, entry.seq))
+                return None, None, result
+            # no window open (state machine hosted outside the manager's
+            # apply loop): drive the chain alone
+            try:
+                result, error = result.run(), None
+            except Exception as e:  # noqa: BLE001
+                result, error = None, str(e)
+                self.log.clean(entry.index)
         if entry.seq:
             session.cache_response(entry.seq, entry.index, result, error)
-        return result, error
+        return result, error, None
 
     def _apply_configuration(self, entry: ConfigurationEntry) -> None:
         self.members = list(entry.members)

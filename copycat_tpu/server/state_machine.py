@@ -100,6 +100,11 @@ class StateMachineExecutor:
         self._callbacks[op_type] = callback
         return self
 
+    def rewrap(self, wrapper: Callable[[Callable], Callable]) -> None:
+        """Rewrite every registered callback through ``wrapper`` (the
+        device executor wraps generator handlers into batchable jobs)."""
+        self._callbacks = {t: wrapper(fn) for t, fn in self._callbacks.items()}
+
     def callback_for(self, op_type: type) -> Callable[[Commit], Any] | None:
         for cls in op_type.__mro__:
             fn = self._callbacks.get(cls)

@@ -1,0 +1,170 @@
+"""The shared device round pump (VERDICT r3 #3).
+
+Round 3's SPI device plane committed one op per engine round-trip
+(submit → run_until([tag]) → 2 settle rounds), so the public resource API
+reached the device at per-op latency. The DeviceWindow batches many
+handler chains into shared rounds: K independent one-op handlers must
+cost ~one chain's rounds, not K chains'.
+
+Reference obligation: the public API *is* the data path
+(``Atomix.java:205``, ``AtomixReplica.java:374``).
+"""
+
+import asyncio
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from copycat_tpu.atomic import DistributedAtomicLong  # noqa: E402
+from copycat_tpu.collections import DistributedMap  # noqa: E402
+from copycat_tpu.io.local import LocalServerRegistry, LocalTransport  # noqa: E402
+from copycat_tpu.manager.atomix import AtomixClient, AtomixServer  # noqa: E402
+from copycat_tpu.manager.device_executor import (  # noqa: E402
+    DeviceEngine,
+    DeviceEngineConfig,
+    DeviceJob,
+)
+from copycat_tpu.ops.apply import OP_LONG_ADD  # noqa: E402
+
+from helpers import async_test  # noqa: E402
+from raft_fixtures import next_ports  # noqa: E402
+
+ENGINE = DeviceEngineConfig(capacity=64, num_peers=3, log_slots=32)
+
+
+def _one_add(engine: DeviceEngine, group: int, amount: int) -> DeviceJob:
+    def chain():
+        result = yield ("cmd", OP_LONG_ADD, amount, 0, 0)
+        return result
+
+    return DeviceJob(engine, group, False, chain())
+
+
+def test_window_shares_rounds_across_groups():
+    engine = DeviceEngine(ENGINE)
+    warm_groups = engine._ensure()
+    r0 = warm_groups.rounds
+
+    window = engine.begin_window()
+    results = {}
+    for g in range(32):
+        window.add_job(_one_add(engine, g, g + 1),
+                       on_done=lambda res, exc, _g=g: results.__setitem__(_g, res))
+    window.close()
+
+    rounds = engine._groups.rounds - r0
+    assert results == {g: g + 1 for g in range(32)}
+    # 32 independent one-op chains through the per-op path would cost
+    # >= 32 rounds (3x that with settles); shared rounds must stay flat.
+    assert rounds <= 8, f"window used {rounds} rounds for 32 one-op chains"
+
+
+def test_window_serializes_same_group_chains_in_order():
+    engine = DeviceEngine(ENGINE)
+    engine._ensure()
+
+    window = engine.begin_window()
+    results = []
+    for i in range(5):
+        window.add_job(_one_add(engine, 0, 10),
+                       on_done=lambda res, exc: results.append(res))
+    window.close()
+    # same group: strict FIFO -> a running counter, not interleaved adds
+    assert results == [10, 20, 30, 40, 50]
+
+
+def test_window_finalizes_in_add_order():
+    engine = DeviceEngine(ENGINE)
+    engine._ensure()
+    window = engine.begin_window()
+    done = []
+    window.add_job(_one_add(engine, 1, 1),
+                   on_done=lambda res, exc: done.append("job"))
+    window.add_ready(lambda res, exc: done.append("ready"))
+    window.close()
+    assert done == ["job", "ready"]
+
+
+def test_window_surfaces_chain_exceptions_to_on_done():
+    engine = DeviceEngine(ENGINE)
+    engine._ensure()
+
+    def boom():
+        yield ("cmd", OP_LONG_ADD, 1, 0, 0)
+        raise ValueError("chain failed")
+
+    window = engine.begin_window()
+    seen = {}
+    window.add_job(DeviceJob(engine, 2, False, boom()),
+                   on_done=lambda res, exc: seen.update(res=res, exc=exc))
+    window.close()
+    assert isinstance(seen["exc"], ValueError)
+
+
+@async_test(timeout=300)
+async def test_spi_batching_end_to_end():
+    """Pipelined increments over many device-backed resources through the
+    public API share engine rounds (single server: the deferred commit
+    advance batches concurrent appends into one apply window)."""
+    registry = LocalServerRegistry()
+    addrs = next_ports(1)
+    server = AtomixServer(addrs[0], addrs, LocalTransport(registry),
+                          election_timeout=0.2, heartbeat_interval=0.04,
+                          session_timeout=10.0, executor="tpu",
+                          engine_config=ENGINE)
+    await server.open()
+    client = AtomixClient(addrs, LocalTransport(registry),
+                          session_timeout=10.0)
+    await client.open()
+    try:
+        n = 24
+        counters = await asyncio.gather(
+            *(client.get(f"ctr{i}", DistributedAtomicLong) for i in range(n)))
+        engine = server.server.state_machine.device_engine
+        r0 = engine._groups.rounds
+
+        reps = 4
+        for _ in range(reps):
+            got = await asyncio.gather(
+                *(c.increment_and_get() for c in counters))
+        assert got == [reps] * n
+
+        rounds = engine._groups.rounds - r0
+        # per-op cost would be >= 3 rounds x n x reps = 288; batching must
+        # beat one round per op even with imperfect arrival batching
+        assert rounds < 3 * n * reps / 2, f"{rounds} rounds for {n*reps} ops"
+
+        # capacity is no longer 16: all 24 resources went on-device
+        assert engine._next_group >= n
+    finally:
+        await asyncio.wait_for(client.close(), 5)
+        await asyncio.wait_for(server.close(), 5)
+
+
+@async_test(timeout=300)
+async def test_ttl_under_window_still_fires(monkeypatch):
+    """Timer-fired device chains (map TTL eviction) spawned mid-window run
+    at their log-ordered slot."""
+    registry = LocalServerRegistry()
+    addrs = next_ports(1)
+    server = AtomixServer(addrs[0], addrs, LocalTransport(registry),
+                          election_timeout=0.2, heartbeat_interval=0.04,
+                          session_timeout=10.0, executor="tpu",
+                          engine_config=ENGINE)
+    await server.open()
+    client = AtomixClient(addrs, LocalTransport(registry),
+                          session_timeout=10.0)
+    await client.open()
+    try:
+        m = await client.get("ttlmap", DistributedMap)
+        await m.put(1, 100, ttl=0.3)
+        assert await m.get(1) == 100
+        await asyncio.sleep(0.9)
+        # later ops advance the log clock past the deadline
+        await m.put(2, 200)
+        assert await m.get(1) is None
+        assert await m.get(2) == 200
+    finally:
+        await asyncio.wait_for(client.close(), 5)
+        await asyncio.wait_for(server.close(), 5)
