@@ -22,7 +22,7 @@ from typing import Any
 import jax
 import numpy as np
 
-from ..ops.apply import OP_CFG_ADD, OP_CFG_REMOVE
+from ..ops.apply import FAIL, OP_CFG_ADD, OP_CFG_REMOVE, QUERY_OPCODES
 from ..ops.consensus import (
     Config,
     RaftState,
@@ -61,6 +61,8 @@ class RaftGroups:
         seed: int = 0,
         mesh: Any | None = None,
         voters: int | None = None,
+        *,
+        _build_state: bool = True,
     ) -> None:
         self.num_groups = num_groups
         self.num_peers = num_peers
@@ -81,20 +83,31 @@ class RaftGroups:
 
         key = jax.random.PRNGKey(seed)
         self._key, init_key = jax.random.split(key)
-        self.state: RaftState = init_state(num_groups, num_peers, log_slots,
-                                           init_key, self.config,
-                                           members=members)
-        self.deliver = full_delivery(num_groups, num_peers)
-        if mesh is not None:
-            from ..parallel import shard_state, shard_step_inputs
-            self.state = shard_state(self.state, mesh)
-            _, self.deliver = shard_step_inputs(
-                self._empty_submits(), self.deliver, mesh)
+        if _build_state:
+            self.state: RaftState = init_state(num_groups, num_peers,
+                                               log_slots, init_key,
+                                               self.config, members=members)
+            self.deliver = full_delivery(num_groups, num_peers)
+            if mesh is not None:
+                from ..parallel import shard_state, shard_step_inputs
+                self.state = shard_state(self.state, mesh)
+                _, self.deliver = shard_step_inputs(
+                    self._empty_submits(), self.deliver, mesh)
 
-        # Config-keyed jit cache: many RaftGroups instances with the same
-        # Config (e.g. one device engine per server in a multi-server test)
-        # share ONE compiled program instead of recompiling per instance.
-        self._step, self._query, self._install = _jitted_programs(self.config)
+            # Config-keyed jit cache: many RaftGroups instances with the
+            # same Config (e.g. one device engine per server in a
+            # multi-server test) share ONE compiled program instead of
+            # recompiling per instance.
+            self._step, self._query, self._install = _jitted_programs(
+                self.config)
+        else:
+            # A subclass (parallel/multihost.py) supplies globally sharded
+            # state/deliver and sharding-pinned jit wrappers itself —
+            # building throwaway local versions here wasted a full state
+            # allocation at startup (ADVICE r3 #2).
+            self.state = None
+            self.deliver = None
+            self._step = self._query = self._install = None
         self._queues: dict[int, deque] = {}
         self._query_queues: dict[int, deque] = {}
         self._query_atomic: set[int] = set()  # tags needing the lease gate
@@ -191,7 +204,6 @@ class RaftGroups:
         reads without a log entry (``Consistency.java:157-176``). Either
         escalates to the command path automatically when unservable.
         Resolves in ``results`` like :meth:`submit`."""
-        from ..ops.apply import QUERY_OPCODES
         if opcode not in QUERY_OPCODES:
             # query_step discards state: a write here would be silently
             # dropped while acking success (reference rejects them too)
@@ -470,7 +482,6 @@ class RaftGroups:
                 if tag in self._inflight:
                     self._inflight.pop(tag)
                     self._inflight_ops.pop(tag, None)
-                    from ..ops.apply import FAIL
                     self.results[tag] = FAIL
                     failed.inc()
         rejected = valid & ~acc & ~refused
@@ -661,7 +672,6 @@ class RaftGroups:
         — ``testServerLeave``). Removing the last member is refused: the
         tag resolves to ``apply.FAIL``. A leader removing itself commits
         the change under the old config and then steps down."""
-        from ..ops.apply import OP_CFG_REMOVE
         if not self.config.dynamic_membership:
             raise ValueError("membership changes need "
                              "Config(dynamic_membership=True)")
@@ -669,12 +679,34 @@ class RaftGroups:
             raise ValueError(f"peer {peer} outside 0..{self.num_peers - 1}")
         return self.submit(group, OP_CFG_REMOVE, peer)
 
+    @staticmethod
+    def _config_mask(member: np.ndarray, applied: np.ndarray,
+                     term: np.ndarray, role: np.ndarray) -> int:
+        """Freshest applied config bitmask among one group's [P] lanes.
+
+        Prefers the CURRENT leader's lane (it serializes config changes,
+        so it carries the freshest applied config) — guarded by term so a
+        partitioned zombie leader (still role==leader at a stale term)
+        cannot shadow the committed config. Leaderless, falls back to the
+        most-applied lane, which can transiently lag by one change during
+        a snapshot-install/catch-up window (callers that need the
+        post-change view step the engine first, as the membership tests
+        do)."""
+        leaders = np.nonzero(role == 2)[0]
+        if len(leaders):
+            lead = int(leaders[np.argmax(term[leaders])])
+            if term[lead] == term.max():
+                return int(member[lead])
+        return int(member[int(np.argmax(applied))])
+
     def voting_members(self, group: int) -> list[int]:
-        """Current voter lanes of ``group``, read from the most-applied
-        lane's config bitmask (the freshest committed config)."""
-        member = np.asarray(self.state.member[group])      # [P] bitmasks
-        applied = np.asarray(self.state.applied_index[group])
-        mask = int(member[int(np.argmax(applied))])
+        """Current voter lanes of ``group`` (see :meth:`_config_mask` for
+        the lane-selection rule)."""
+        s = self.state
+        mask = self._config_mask(np.asarray(s.member[group]),
+                                 np.asarray(s.applied_index[group]),
+                                 np.asarray(s.term[group]),
+                                 np.asarray(s.role[group]))
         return [p for p in range(self.num_peers) if (mask >> p) & 1]
 
     # -- inspection --------------------------------------------------------

@@ -94,10 +94,12 @@ class MultiHostRaftGroups(RaftGroups):
         self.global_groups = groups_per_process * self.process_count
         self.group_offset = groups_per_process * self.process_index
         # Base init sizes ALL host bookkeeping to the local block (its
-        # num_groups) and compiles the shared jit wrappers; its locally
-        # shaped state/deliver are replaced with global sharded ones.
+        # num_groups); _build_state=False (subclass protocol, not public
+        # API) skips the locally shaped state/deliver/jit wrappers that
+        # this __init__ replaces with global sharded versions below.
         super().__init__(groups_per_process, num_peers, log_slots,
-                         submit_slots, config, seed, voters=voters)
+                         submit_slots, config, seed, voters=voters,
+                         _build_state=False)
         self.mesh = global_mesh()
         self._sub_sharding = NamedSharding(self.mesh, P("groups", None))
         self._dl_sharding = NamedSharding(self.mesh, P("groups", None, None))
@@ -212,7 +214,11 @@ class MultiHostRaftGroups(RaftGroups):
                    [group, peer])
 
     def voting_members(self, group: int) -> list[int]:
-        member = self._local_block(self.state.member)[group]
-        applied = self._local_block(self.state.applied_index)[group]
-        mask = int(member[int(np.argmax(applied))])
+        # same lane-selection rule as the base class (_config_mask), over
+        # this process's local block of the sharded state
+        mask = self._config_mask(
+            self._local_block(self.state.member)[group],
+            self._local_block(self.state.applied_index)[group],
+            self._local_block(self.state.term)[group],
+            self._local_block(self.state.role)[group])
         return [p for p in range(self.num_peers) if (mask >> p) & 1]
