@@ -35,6 +35,43 @@ PEERS = 3
 ROUNDS = int(os.environ.get("COPYCAT_SCALING_ROUNDS", "30"))
 
 
+COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                  "collective-permute", "all-to-all")
+# The census compiles its own (small) module: AOT lower().compile() and
+# the jit call cache do not share executables, so running the census at
+# measurement size would pay a redundant full compile per device count.
+# Collective structure depends only on the sharding pattern, not G.
+CENSUS_GROUPS = 256
+
+
+def _collective_census(n_devices: int, devices) -> dict:
+    """Count cross-device collective ops in the compiled module — the
+    direct witness for (non-)resharding: a purely group-sharded step is
+    embarrassingly parallel and must compile to ZERO collectives."""
+    import re
+    from functools import partial
+
+    from jax.sharding import Mesh
+
+    from ..ops.consensus import (
+        Config, full_delivery, init_state, make_submits, step)
+    from ..parallel.mesh import shard_state, shard_step_inputs
+
+    mesh = Mesh(np.asarray(devices[:n_devices]), ("groups",))
+    config = Config()
+    key = jax.random.PRNGKey(0)
+    key, init_key = jax.random.split(key)
+    state = init_state(CENSUS_GROUPS, PEERS, 32, init_key, config)
+    submits = make_submits(CENSUS_GROUPS, 4)
+    deliver = full_delivery(CENSUS_GROUPS, PEERS)
+    state = shard_state(state, mesh)
+    submits, deliver = shard_step_inputs(submits, deliver, mesh)
+    fn = jax.jit(partial(step, config=config))
+    txt = fn.lower(state, submits, deliver, key).compile().as_text()
+    return {op: n for op in COLLECTIVE_OPS
+            if (n := len(re.findall(rf"\b{op}\b", txt)))}
+
+
 def _measure(n_devices: int, devices) -> dict:
     from functools import partial
 
@@ -54,6 +91,7 @@ def _measure(n_devices: int, devices) -> dict:
     state = shard_state(state, mesh)
     submits, deliver = shard_step_inputs(submits, deliver, mesh)
     fn = jax.jit(partial(step, config=config))
+    collectives = _collective_census(n_devices, devices)
 
     t0 = time.perf_counter()
     for _ in range(3):  # warm-up (includes compile)
@@ -70,7 +108,8 @@ def _measure(n_devices: int, devices) -> dict:
     dt = time.perf_counter() - t0
     return {"devices": n_devices,
             "ms_per_round": round(dt / ROUNDS * 1e3, 2),
-            "warmup_s": round(compile_s, 1)}
+            "warmup_s": round(compile_s, 1),
+            "collectives": collectives}
 
 
 def main() -> None:
@@ -78,31 +117,57 @@ def main() -> None:
     if len(devices) < 8:
         raise SystemExit("need 8 virtual CPU devices (set XLA_FLAGS before "
                          "any jax import)")
+    host_cores = (len(os.sched_getaffinity(0))
+                  if hasattr(os, "sched_getaffinity") else os.cpu_count())
     rows = [_measure(n, devices) for n in (1, 2, 4, 8)]
     base = rows[0]["ms_per_round"]
     for row in rows:
         row["vs_1dev"] = round(row["ms_per_round"] / base, 2)
+    no_collectives = all(not row["collectives"] for row in rows)
     result = {"groups": GROUPS, "peers": PEERS, "rounds": ROUNDS,
-              "mesh_axis": "groups", "table": rows}
+              "mesh_axis": "groups", "host_cores": host_cores,
+              "no_cross_device_collectives": no_collectives,
+              "table": rows}
 
     lines = [
-        "# MULTICHIP_SCALING — sharded step walltime over the virtual mesh",
+        "# MULTICHIP_SCALING — sharded step over the virtual mesh",
         "",
         f"Fixed total work ({GROUPS} groups × {PEERS} peers, full default",
         "pools) jitted over 1/2/4/8 virtual CPU devices, group axis",
         "sharded (`copycat_tpu/parallel/mesh.py`), measured with",
-        "`python -m copycat_tpu.parallel.scaling`. Virtual CPU devices",
-        "share host cores, so flat-or-better walltime is the pass",
-        "criterion: it shows XLA's inserted collectives stay proportional",
-        "(no resharding pathology on the step's dataflow) before real",
-        "multi-chip hardware is ever involved.",
+        "`python -m copycat_tpu.parallel.scaling`.",
         "",
-        "| devices | ms/round | vs 1 device |",
-        "|---|---|---|",
+        "## Pass criterion (round 4): no cross-device collectives",
+        "",
+        "The compiled module of the sharded step is inspected per device",
+        "count. A purely group-sharded step is embarrassingly parallel —",
+        "groups are independent Raft worlds — so the correct compilation",
+        "target is ZERO cross-device collectives (no all-reduce /",
+        "all-gather / reduce-scatter / collective-permute / all-to-all),",
+        "which is the direct witness that XLA inserts no resharding on",
+        "the step's dataflow. Measured:",
+        "",
+        f"- cross-device collectives at 1/2/4/8 devices: "
+        + ("**none** ✓" if no_collectives else "**FOUND** ✗ (see JSON)"),
+        f"- host cores available to this process: **{host_cores}**",
+        "",
+        "Walltime on the virtual mesh is diagnostic only: virtual CPU",
+        "devices share host cores, so with fewer cores than devices the",
+        "per-round time grows with device count from pure host",
+        "oversubscription (program launch + inter-device rendezvous on a",
+        "shared core), not from communication — the round-3 8-device",
+        "\"regression\" reproduced exactly this on a 1-core host while",
+        "the compiled modules contain no collectives at all. On real",
+        "multi-chip hardware each shard owns a chip and the same program",
+        "runs with no cross-chip traffic in the step.",
+        "",
+        "| devices | ms/round | vs 1 device | collectives |",
+        "|---|---|---|---|",
     ]
     for row in rows:
+        cl = row["collectives"] or "none"
         lines.append(f"| {row['devices']} | {row['ms_per_round']} "
-                     f"| {row['vs_1dev']}× |")
+                     f"| {row['vs_1dev']}× | {cl} |")
     lines += [
         "",
         "The peer axis stays replicated here (P=3 quorum tallies are",

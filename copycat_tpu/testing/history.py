@@ -19,6 +19,7 @@ class HistoryRecorder:
         self._rg = rg
         self._pending: dict[int, tuple[int, tuple, int]] = {}
         self._done: dict[int, list[HOp]] = {}
+        self._pending_per_group: dict[int, int] = {}
 
     def invoke(self, group: int, opcode: int, model_op: tuple,
                a: int = 0, b: int = 0, c: int = 0,
@@ -35,7 +36,16 @@ class HistoryRecorder:
         else:
             tag = self._rg.submit(group, opcode, a, b, c)
         self._pending[tag] = (group, model_op, self._rg.rounds)
+        self._pending_per_group[group] = \
+            self._pending_per_group.get(group, 0) + 1
         return tag
+
+    def pending_count(self, group: int) -> int:
+        """In-flight recorded ops for ``group`` — drivers bound this like
+        a real client's concurrency window (unbounded pipelining under a
+        long fault otherwise piles up incomplete ops, which both distorts
+        the workload and blows up the checker's search)."""
+        return self._pending_per_group.get(group, 0)
 
     def tick(self, n: int = 1) -> None:
         """Advance the cluster, harvesting completions."""
@@ -47,6 +57,7 @@ class HistoryRecorder:
         finished = [t for t in self._pending if t in self._rg.results]
         for tag in finished:
             group, model_op, invoke = self._pending.pop(tag)
+            self._pending_per_group[group] -= 1
             self._done.setdefault(group, []).append(HOp(
                 op_id=tag, op=model_op, result=self._rg.results[tag],
                 invoke=invoke, complete=self._rg.rounds))
