@@ -201,6 +201,14 @@ class Config(NamedTuple):
     # change membership at apply time. When False (default) the step
     # compiles exactly as before — static P-lane quorum, member unread.
     dynamic_membership: bool = False
+    # Refuse submit acceptance at a leader that did not hold the lease
+    # (quorum-acked latest round) LAST round. An entry appended to a
+    # partitioned leader's log otherwise rots until heal/supersession —
+    # the round-3 mixed-bench p99 of 459 ms was exactly one op waiting
+    # out a whole isolation window. Refused slots requeue host-side and
+    # land on a live leader within ~an election of the fault, pulling
+    # the tail to the election timescale at unchanged throughput.
+    lease_gated_accept: bool = True
 
 
 def init_state(num_groups: int, num_peers: int, log_slots: int,
@@ -506,7 +514,12 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
         q_applied = _kth(state.applied_index, quorum)
     allowed_last = jnp.minimum(l_applied, q_applied) + L
 
-    valid = submits.valid & active[:, None]
+    accept_ok = active
+    if config.lease_gated_accept:
+        # last round's quorum-ack witness at the leader lane: no lease →
+        # no new appends (host requeues; see Config.lease_gated_accept)
+        accept_ok = active & (_peer_view(state.lease, lead) != 0)
+    valid = submits.valid & accept_ok[:, None]
     if dyn:
         # Config-change append guard + full-config composition: ONE
         # change in flight at a time (adjacent single-server configs

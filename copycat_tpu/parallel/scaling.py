@@ -30,6 +30,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax
 import numpy as np
 
+from ..utils.platform import honor_jax_platforms_env
+
+# JAX_PLATFORMS=cpu must WIN over plugin site config, or backend
+# discovery dials the (possibly dead) accelerator tunnel and hangs —
+# the same hazard the driver-graded entry points guard against.
+honor_jax_platforms_env()
+
 GROUPS = int(os.environ.get("COPYCAT_SCALING_GROUPS", "4096"))
 PEERS = 3
 ROUNDS = int(os.environ.get("COPYCAT_SCALING_ROUNDS", "30"))
