@@ -6,6 +6,7 @@ one-``ResourceManager``-per-server design, ``AtomixReplica.java:374``).
 """
 
 from .raft_groups import RaftGroups  # noqa: F401
+from .bulk import BulkDriver, BulkResult, drive_batch  # noqa: F401
 from .device_resources import (  # noqa: F401
     DeviceElection,
     DeviceLock,

@@ -1,0 +1,200 @@
+"""Pipelined bulk data plane: client-visible throughput at device scale.
+
+The queue-managed host runtime (``RaftGroups.submit``/``run_until``)
+pays Python per op — deque staging, dict harvest, retry bookkeeping —
+which caps client-visible throughput around ~10^5 ops/sec regardless of
+device speed. This driver is the other end of the trade: a VECTORIZED
+submit scheduler (numpy fancy-indexing end to end, zero per-op Python)
+with DOUBLE-BUFFERED rounds — round N+1 is dispatched before round N's
+outputs are fetched, so host staging/harvest overlaps device compute and
+the tunnel round-trip (the round-3 residual: one serialized
+submit→compute→fetch cycle per round).
+
+Safety vs the queue-managed path:
+
+- SAFETY is unconditional: an op is resubmitted only if its slot was NOT
+  accepted into a leader log (``out.accepted``); accepted ops are never
+  re-sent, so double-apply is impossible under any fault.
+- LIVENESS assumes fault-free delivery (the engine's own full-delivery
+  default): an accepted entry lost to a leader change would never
+  resolve and ``drive`` raises after ``max_rounds``. Clients running
+  under nemesis/partitions belong on the queue-managed path, whose
+  provable-loss retry handles exactly that (``raft_groups._harvest``).
+
+Reference framing: the reference's client runtime pipelines sequenced
+commands per session (Copycat client, SURVEY.md §2.3); this is the
+batch-scale equivalent for the north-star metric (BASELINE.md: ≥1M
+client-visible linearizable ops/sec).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+
+class BulkResult:
+    """Results + client-observed latency percentiles for one drive."""
+
+    __slots__ = ("results", "rounds", "wall_s", "dispatch_round",
+                 "resolve_round")
+
+    def __init__(self, results, rounds, wall_s, dispatch_round,
+                 resolve_round) -> None:
+        self.results = results
+        self.rounds = rounds
+        self.wall_s = wall_s
+        self.dispatch_round = dispatch_round
+        self.resolve_round = resolve_round
+
+    def latency_rounds(self) -> np.ndarray:
+        """Per-op submit→result latency in driver rounds (client view)."""
+        return self.resolve_round - self.dispatch_round + 1
+
+    def latency_percentiles_ms(self, qs=(50, 99)) -> dict:
+        lat = self.latency_rounds().astype(np.float64)
+        ms_per_round = self.wall_s * 1e3 / max(1, self.rounds)
+        return {f"p{q}": float(np.percentile(lat, q)) * ms_per_round
+                for q in qs}
+
+
+class BulkDriver:
+    """Vectorized pipelined driver over one :class:`RaftGroups` batch."""
+
+    def __init__(self, rg) -> None:
+        self._rg = rg
+
+    def drive(self, groups, opcode, a=0, b=0, c=0,
+              max_rounds: int = 10_000) -> BulkResult:
+        """Commit one op per entry of ``groups`` (scalars broadcast) and
+        return all results; ops of one group keep submission order.
+
+        Scheduling rule (FIFO-safe by construction): each round every
+        group dispatches its first ≤S not-yet-ACCEPTED ops in op order —
+        an op the engine rejected (backpressure, lease-refusal) is
+        re-sent before any later op of its group is ever dispatched.
+        The tiny per-round ``accepted`` array is fetched synchronously
+        to drive that rule; the large result arrays are harvested one
+        round behind (double buffer), so host staging and the bulk of
+        the D2H transfer overlap device compute.
+        """
+        rg = self._rg
+        S = rg.submit_slots
+        t0 = time.perf_counter()
+
+        g_arr = np.asarray(groups, np.int64).ravel()
+        n = g_arr.size
+        bc = lambda x: np.broadcast_to(
+            np.asarray(x, np.int32).ravel(), (n,)).copy()
+        op_a, a_a, b_a, c_a = bc(opcode), bc(a), bc(b), bc(c)
+
+        # fixed group-stable order + segment starts for per-round ranking
+        order = np.argsort(g_arr, kind="stable")
+        g_sorted = g_arr[order]
+        first = np.ones(n, bool)
+        first[1:] = g_sorted[1:] != g_sorted[:-1]
+        starts = np.flatnonzero(first)
+        counts = np.diff(np.append(starts, n))
+
+        # tags are a RESERVED contiguous block off the engine's counter,
+        # so bulk tags can never collide with queue-path tags or an
+        # earlier drive's re-reported entries
+        tag0 = rg._next_tag
+        rg._next_tag += n
+        results = np.zeros(n, np.int64)
+        resolved = np.zeros(n, bool)
+        accepted_ops = np.zeros(n, bool)
+        dispatched = np.zeros(n, bool)
+        dispatch_round = np.zeros(n, np.int64)
+        resolve_round = np.zeros(n, np.int64)
+
+        def build(r: int):
+            """First ≤S unaccepted ops per group, in op order."""
+            mask = ~accepted_ops[order]
+            mi = mask.astype(np.int64)
+            excl = np.cumsum(mi) - mi          # exclusive prefix count
+            base = np.repeat(excl[starts], counts)
+            rank = excl - base                 # unaccepted-rank in group
+            sel = mask & (rank < S)
+            idx = order[sel]
+            slots = rank[sel]
+            sub = rg._empty_submits()
+            gi = g_arr[idx]
+            sub.opcode[gi, slots] = op_a[idx]
+            sub.a[gi, slots] = a_a[idx]
+            sub.b[gi, slots] = b_a[idx]
+            sub.c[gi, slots] = c_a[idx]
+            sub.tag[gi, slots] = (tag0 + idx).astype(np.int32)
+            sub.valid[gi, slots] = True
+            fresh = ~dispatched[idx]
+            dispatch_round[idx[fresh]] = r
+            dispatched[idx] = True
+            return sub, idx, gi, slots
+
+        def harvest(r: int, raw) -> None:
+            for leaf in (raw.out_valid, raw.out_tag, raw.out_result):
+                leaf.copy_to_host_async()
+            ov = np.asarray(raw.out_valid)
+            if ov.any():
+                tags = np.asarray(raw.out_tag)[ov]
+                vals = np.asarray(raw.out_result)[ov]
+                keep = (tags >= tag0) & (tags < tag0 + n)
+                t = tags[keep] - tag0
+                results[t] = vals[keep]
+                newly = ~resolved[t]
+                resolve_round[t[newly]] = r
+                resolved[t] = True
+
+        deliver = rg.deliver
+        inflight: list[tuple[int, Any]] = []
+        r = 0
+        while not resolved.all():
+            if r > max_rounds:
+                missing = int(n - resolved.sum())
+                raise TimeoutError(
+                    f"bulk drive: {missing} ops unresolved after "
+                    f"{max_rounds} rounds (fault-free liveness assumption"
+                    f" violated? use the queue-managed path under faults)")
+            sub, idx, gi, slots = build(r)
+            rg._key, key = jax.random.split(rg._key)
+            rg.state, raw = rg._step(rg.state, sub, deliver, key)
+            # small synchronous fetch: acceptance gates the NEXT round's
+            # dispatch window (FIFO safety)
+            if idx.size:
+                acc = np.asarray(raw.accepted)
+                accepted_ops[idx[acc[gi, slots]]] = True
+            # big outputs: one round behind (double buffer)
+            inflight.append((r, raw))
+            if len(inflight) > 1:
+                pr, praw = inflight.pop(0)
+                harvest(pr, praw)
+            r += 1
+            if resolved.all():
+                break
+            # drain the pipe when nothing is left to dispatch so the
+            # last round's results are seen without an extra device step
+            if accepted_ops.all() and inflight:
+                pr, praw = inflight.pop(0)
+                harvest(pr, praw)
+        while inflight:
+            pr, praw = inflight.pop(0)
+            harvest(pr, praw)
+        if not resolved.all():  # pragma: no cover - defensive
+            missing = int(n - resolved.sum())
+            raise TimeoutError(f"bulk drive: {missing} ops unresolved")
+        rg.rounds += r
+        rg.metrics.counter("ops_committed").inc(n)
+        return BulkResult(results=results, rounds=r,
+                          wall_s=time.perf_counter() - t0,
+                          dispatch_round=dispatch_round,
+                          resolve_round=resolve_round)
+
+
+def drive_batch(rg, groups, opcode, a=0, b=0, c=0,
+                max_rounds: int = 10_000) -> BulkResult:
+    """Module-level convenience: ``BulkDriver(rg).drive(...)``."""
+    return BulkDriver(rg).drive(groups, opcode, a, b, c,
+                                max_rounds=max_rounds)
