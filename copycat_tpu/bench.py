@@ -425,24 +425,23 @@ def run_host() -> dict:
 
     lat_p50 = lat_p99 = 0.0
 
-    def burst() -> float:
-        nonlocal lat_p50, lat_p99
+    def burst() -> tuple[float, dict | None]:
         if mode == "bulk":
             res = driver.drive(groups, ap.OP_LONG_ADD, 1)
-            pct = res.latency_percentiles_ms()
-            lat_p50, lat_p99 = pct["p50"], pct["p99"]
-            return groups.size / res.wall_s
+            return groups.size / res.wall_s, res.latency_percentiles_ms()
         t0 = time.perf_counter()
         tags = rg.submit_batch(groups, ap.OP_LONG_ADD, 1).tolist()
         rg.run_until(tags, max_rounds=120)
-        return len(tags) / (time.perf_counter() - t0)
+        return len(tags) / (time.perf_counter() - t0), None
 
     burst()  # warm (jit compile + first transfers)
     best = 0.0
     reps = []
     for rep in range(REPEATS):
         with xla_trace(PROFILE_DIR if rep == 0 else None):
-            ops = burst()
+            ops, pct = burst()
+        if ops >= best and pct is not None:
+            lat_p50, lat_p99 = pct["p50"], pct["p99"]  # pair with `value`
         best = max(best, ops)
         reps.append(ops)
         log(f"bench[host:{mode}]: rep {rep}: {ops:,.0f} committed "

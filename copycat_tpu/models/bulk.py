@@ -65,6 +65,23 @@ class BulkDriver:
     """Vectorized pipelined driver over one :class:`RaftGroups` batch."""
 
     def __init__(self, rg) -> None:
+        # Single-host engines only: the bulk loop feeds host numpy
+        # straight into the step and fetches whole outputs, bypassing the
+        # multihost staging/lockstep hooks step_round routes through.
+        from .raft_groups import RaftGroups
+        if (getattr(rg, "process_count", 1) > 1
+                or type(rg)._stage_submits is not RaftGroups._stage_submits
+                or type(rg)._fetch_outputs is not RaftGroups._fetch_outputs):
+            raise NotImplementedError(
+                "BulkDriver drives single-host RaftGroups only; multihost "
+                "engines use the queue-managed lockstep path")
+        # Device-session engines need the per-round session tick (keep-
+        # alives ride the queue-managed submit path the bulk loop never
+        # drains) — refuse rather than silently expire sessions.
+        if rg._sessions is not None:
+            raise NotImplementedError(
+                "BulkDriver does not pump device sessions; use the "
+                "queue-managed path (step_round) on session engines")
         self._rg = rg
 
     def drive(self, groups, opcode, a=0, b=0, c=0,
@@ -147,6 +164,21 @@ class BulkDriver:
                 newly = ~resolved[t]
                 resolve_round[t[newly]] = r
                 resolved[t] = True
+                # entries reported once: a queue-managed op that applied
+                # during this drive must resolve into rg.results, not
+                # vanish behind the bulk tag filter
+                for tg, vl in zip(tags[~keep].tolist(),
+                                  vals[~keep].tolist()):
+                    if tg in rg._inflight:
+                        rg._inflight.pop(tg)
+                        rg._inflight_ops.pop(tg, None)
+                        placed = rg._tag_index.pop(tg, None)
+                        if placed is not None:
+                            rg._drop_placement(placed[0], placed[1])
+                        rg.results[tg] = vl
+            # session events drained by these rounds must reach the host
+            # buffer (the device pops its ring as it drains)
+            rg._ingest_events(raw)
 
         deliver = rg.deliver
         inflight: list[tuple[int, Any]] = []

@@ -567,6 +567,13 @@ class RaftGroups:
                     latency.record(rounds - submit_round)
             if n_done:
                 self.metrics.counter("ops_committed").inc(n_done)
+        self._ingest_events(out)
+
+    def _ingest_events(self, out) -> None:
+        """Append this round's drained session events to the host buffer
+        (dedup by absolute seq). Shared by every driver that steps the
+        engine — the device pops events off its ring when drained, so a
+        driver that skipped this would LOSE them."""
         ev_valid = np.asarray(out.ev_valid)
         if ev_valid.any():
             seq = np.asarray(out.ev_seq)

@@ -58,6 +58,22 @@ def test_bulk_and_queued_paths_interleave_without_tag_collisions(rg):
     assert rg.results[t] >= 1000  # queue op resolved with its own value
 
 
+def test_queue_op_applying_during_bulk_drive_still_resolves(rg):
+    """A queue-managed op already IN the log when a bulk drive starts is
+    reported by the device exactly once — during a bulk round. The bulk
+    harvest must route it into rg.results, not drop it behind the tag
+    filter."""
+    driver = BulkDriver(rg)
+    t = rg.submit(1, ap.OP_LONG_ADD, a=500)
+    rg.step_round()           # accepted into the log, not yet resolved
+    res = driver.drive(np.arange(8), ap.OP_LONG_ADD, 1)
+    assert res.results.size == 8
+    # resolved by the bulk rounds themselves (or the drain) — run_until
+    # must find it already present without timing out
+    rg.run_until([t], max_rounds=10)
+    assert rg.results[t] >= 500
+
+
 def test_bulk_latency_percentiles_shape(rg):
     driver = BulkDriver(rg)
     res = driver.drive(np.arange(8), ap.OP_LONG_ADD, 1)
