@@ -147,6 +147,11 @@ class RaftClient(Managed):
         self._acked_command_seq = 0
         self._index = 0  # high-water log index seen (sequential consistency)
         self._keepalive: Scheduled | None = None
+        # Command micro-batching: same-turn submits coalesce into ONE
+        # CommandBatchRequest (flushed via call_soon at the end of the
+        # event-loop turn); a lone submit still rides CommandRequest.
+        self._pending_batch: list = []
+        self._batch_scheduled = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -288,9 +293,90 @@ class RaftClient(Managed):
             raise SessionExpiredError("session is not open")
         self._command_seq += 1
         seq = self._command_seq
-        response = await self._request(msg.CommandRequest(
-            session_id=self._session.id, seq=seq, operation=operation))
-        return self._finish(response, seq)
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pending_batch.append((seq, operation, fut))
+        if not self._batch_scheduled:
+            self._batch_scheduled = True
+            loop.call_soon(self._launch_batch)
+        return await fut
+
+    def _launch_batch(self) -> None:
+        self._batch_scheduled = False
+        batch, self._pending_batch = self._pending_batch, []
+        if batch:
+            spawn(self._flush_batch(batch), name="command-batch")
+
+    async def _flush_batch(self, batch: list) -> None:
+        if len(batch) == 1:
+            seq, operation, fut = batch[0]
+            try:
+                response = await self._request(msg.CommandRequest(
+                    session_id=self._session.id, seq=seq,
+                    operation=operation))
+                result = self._finish(response, seq)
+            except BaseException as e:  # noqa: BLE001 — delivered via fut
+                if not fut.done():
+                    fut.set_exception(e)
+                return
+            if not fut.done():
+                fut.set_result(result)
+            return
+        try:
+            response = await self._request(msg.CommandBatchRequest(
+                session_id=self._session.id,
+                entries=[(seq, op) for seq, op, _ in batch]))
+            # batch-level fatal (UNKNOWN_SESSION etc.): _finish raises
+            # the right exception type for every entry
+            if getattr(response, "error", None):
+                self._finish(response, None)
+        except BaseException as e:  # noqa: BLE001
+            for _, _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        by_seq = {entry[0]: entry for entry in (response.entries or [])}
+        try:
+            for seq, _, fut in batch:
+                entry = by_seq.get(seq)
+                if entry is None:
+                    if not fut.done():
+                        fut.set_exception(msg.ProtocolError(
+                            msg.INTERNAL,
+                            f"seq {seq} missing from batch response"))
+                    continue
+                _, index, result, code, detail = entry
+                # ack BEFORE consulting fut.done(): a caller-cancelled
+                # command that succeeded server-side must still advance
+                # the contiguous ack prefix, or server response-cache
+                # pruning stalls behind it forever
+                if code in (None, msg.APPLICATION):
+                    self._ack_seq(seq, index)
+                if fut.done():
+                    continue
+                if code == msg.APPLICATION:
+                    fut.set_exception(
+                        ApplicationError(detail or "application error"))
+                elif code:
+                    fut.set_exception(msg.ProtocolError(code, detail or ""))
+                else:
+                    fut.set_result(result)
+        except BaseException as e:  # noqa: BLE001 — no caller may hang
+            for _, _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            raise
+
+    def _ack_seq(self, seq: int, index: int | None) -> None:
+        """Per-command success bookkeeping (the _finish tail): advance the
+        sequential-read index and the contiguous completed-seq prefix the
+        keep-alive acks for server response-cache pruning."""
+        if index:
+            self._index = max(self._index, index)
+        self._completed_seqs.add(seq)
+        while self._acked_command_seq + 1 in self._completed_seqs:
+            self._acked_command_seq += 1
+            self._completed_seqs.discard(self._acked_command_seq)
 
     async def _submit_query(self, operation: Query) -> Any:
         if not self._session.is_open:
@@ -308,13 +394,15 @@ class RaftClient(Managed):
             self._session._expired()
             raise SessionExpiredError("session expired")
         if error == msg.APPLICATION:
+            if seq is not None:
+                # an application error IS a delivered response: ack the
+                # seq or the contiguous ack prefix (and server response-
+                # cache pruning) would stall behind it forever
+                self._ack_seq(seq, getattr(response, "index", None))
             raise ApplicationError(response.error_detail or "application error")
         response.raise_if_error()
-        if response.index:
-            self._index = max(self._index, response.index)
         if seq is not None:
-            self._completed_seqs.add(seq)
-            while self._acked_command_seq + 1 in self._completed_seqs:
-                self._acked_command_seq += 1
-                self._completed_seqs.discard(self._acked_command_seq)
+            self._ack_seq(seq, response.index)
+        elif response.index:
+            self._index = max(self._index, response.index)
         return response.result

@@ -132,6 +132,25 @@ class QueryResponse(Response):
     _fields = ("error", "error_detail", "leader", "index", "result")
 
 
+@serialize_with(224)
+class CommandBatchRequest(Message):
+    """Micro-batched commands: one transport message carrying many
+    sequenced commands from one session (the client's same-turn submits
+    coalesce; the reference's per-command RPC framing pays per-message
+    overhead the batch amortizes). ``entries`` = [(seq, operation), ...]
+    in seq order."""
+
+    _fields = ("session_id", "entries")
+
+
+@serialize_with(225)
+class CommandBatchResponse(Response):
+    """Per-command outcomes: ``entries`` = [(seq, index, result,
+    error_code, error_detail), ...]; ``event_index`` as CommandResponse."""
+
+    _fields = ("error", "error_detail", "leader", "event_index", "entries")
+
+
 @serialize_with(210)
 class PublishRequest(Message):
     """Server -> client event push (session event channel).

@@ -260,6 +260,8 @@ class RaftServer(Managed):
         connection.handler(msg.KeepAliveRequest, lambda m: self._on_keepalive(connection, m))
         connection.handler(msg.UnregisterRequest, self._on_unregister)
         connection.handler(msg.CommandRequest, lambda m: self._on_command(connection, m))
+        connection.handler(msg.CommandBatchRequest,
+                           lambda m: self._on_command_batch(connection, m))
         connection.handler(msg.QueryRequest, self._on_query)
         connection.handler(msg.JoinRequest, self._on_join)
         connection.handler(msg.LeaveRequest, self._on_leave)
@@ -782,15 +784,36 @@ class RaftServer(Managed):
         session.last_contact = time.monotonic()
         seq = request.seq
 
+        staged, payload = self._stage_command(session, seq, request.operation)
+        if staged == "done":
+            index, result, error = payload
+            return self._command_response(session, index, result, error)
+        if staged == "err":
+            code, detail = payload
+            return msg.CommandResponse(error=code, error_detail=detail)
+        fut = payload
+        try:
+            index, result, error = await fut
+        except msg.ProtocolError as e:
+            return msg.CommandResponse(error=e.code, leader=e.leader)
+        finally:
+            if session.command_futures.get(seq) is fut:
+                del session.command_futures[seq]
+        return self._command_response(session, index, result, error)
+
+    def _stage_command(self, session: ServerSession, seq: int,
+                       operation: Any) -> tuple[str, Any]:
+        """Dedup/enqueue one sequenced command; returns
+        ``("done", (index, result, error))`` for a cache hit,
+        ``("err", (code, detail))`` for a pruned duplicate, or
+        ``("wait", future)`` once the command rides the log."""
         # Exactly-once: already applied -> cached response.
         cached = session.cached_response(seq)
         if cached is not None:
-            index, result, error = cached
-            return self._command_response(session, index, result, error)
+            return "done", cached
         if seq <= session.command_high:
-            return msg.CommandResponse(error=msg.INTERNAL,
-                                       error_detail=f"response for seq {seq} already pruned")
-
+            return "err", (msg.INTERNAL,
+                           f"response for seq {seq} already pruned")
         # Already in flight (resubmission) -> share the future.
         fut = session.command_futures.get(seq)
         if fut is None:
@@ -801,20 +824,63 @@ class RaftServer(Managed):
             # after N+1 would silently drop the write.
             if session.next_append_seq == 0:
                 session.next_append_seq = session.command_high + 1
-            session.pending_ops[seq] = request.operation
+            session.pending_ops[seq] = operation
             while session.next_append_seq in session.pending_ops:
                 next_seq = session.next_append_seq
                 session.next_append_seq += 1
                 self._append(CommandEntry(session_id=session.id, seq=next_seq,
                                           operation=session.pending_ops.pop(next_seq)))
-        try:
-            index, result, error = await fut
-        except msg.ProtocolError as e:
-            return msg.CommandResponse(error=e.code, leader=e.leader)
-        finally:
-            if session.command_futures.get(seq) is fut:
-                del session.command_futures[seq]
-        return self._command_response(session, index, result, error)
+        return "wait", fut
+
+    async def _on_command_batch(self, connection: Connection,
+                                request: msg.CommandBatchRequest
+                                ) -> msg.CommandBatchResponse:
+        """Micro-batched commands: stage EVERY entry first (one append
+        burst → one apply window on the device executor), then await the
+        outcomes in seq order. Per-entry results/errors travel in the
+        response's ``entries``; session-fatal conditions ride the
+        response-level error like the single-command path."""
+        if self.role != LEADER:
+            return self._not_leader(msg.CommandBatchResponse)
+        session = self.sessions.get(request.session_id)
+        if session is None or session.state is not SessionState.OPEN:
+            return msg.CommandBatchResponse(error=msg.UNKNOWN_SESSION)
+        session.connection = connection
+        session.last_contact = time.monotonic()
+        staged = [(seq, *self._stage_command(session, seq, op))
+                  for seq, op in (request.entries or [])]
+        entries = []
+        for seq, kind, payload in staged:
+            if kind == "done":
+                index, result, error = payload
+                entries.append((seq, index, result,
+                                msg.APPLICATION if error else None, error))
+            elif kind == "err":
+                code, detail = payload
+                entries.append((seq, 0, None, code, detail))
+            else:
+                fut = payload
+                try:
+                    index, result, error = await fut
+                    entries.append((seq, index, result,
+                                    msg.APPLICATION if error else None,
+                                    error))
+                except msg.ProtocolError as e:
+                    if e.code in (msg.NOT_LEADER, msg.NO_LEADER):
+                        # promote routing failures to the RESPONSE level:
+                        # the client's _request retry loop re-routes and
+                        # resends the whole batch (seq dedup makes the
+                        # resend exactly-once), matching the
+                        # single-command path's transparent failover
+                        return msg.CommandBatchResponse(
+                            error=e.code, leader=e.leader,
+                            error_detail=e.detail)
+                    entries.append((seq, 0, None, e.code, e.detail))
+                finally:
+                    if session.command_futures.get(seq) is fut:
+                        del session.command_futures[seq]
+        return msg.CommandBatchResponse(event_index=session.event_index,
+                                        entries=entries)
 
     def _command_response(self, session: ServerSession, index: int,
                           result: Any, error: str | None) -> msg.CommandResponse:

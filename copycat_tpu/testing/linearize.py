@@ -118,21 +118,20 @@ def check_linearizable(history: list[HOp], model,
     """
     by_id = {h.op_id: h for h in history}
     ids = frozenset(by_id)
+    init = model.init if init_state is None else init_state
+
+    def all_incomplete(remaining: frozenset) -> bool:
+        # only incomplete ops left — they may never apply
+        return all(by_id[i].complete == math.inf for i in remaining)
+
+    if all_incomplete(ids):
+        return CheckResult(ok=True, nodes=0, witness=[])
+
     memo: set = set()
-    nodes = 0
+    nodes = 1
     order: list[int] = []
 
-    def rec(remaining: frozenset, state) -> bool:
-        nonlocal nodes
-        if all(by_id[i].complete == math.inf for i in remaining):
-            return True  # only incomplete ops left — they may never apply
-        key = (remaining, state)
-        if key in memo:
-            return False
-        nodes += 1
-        if nodes > max_nodes:
-            raise RuntimeError(
-                f"linearizability search exceeded {max_nodes} nodes")
+    def candidates(remaining: frozenset, state):
         min_complete = min(by_id[i].complete for i in remaining)
         for i in sorted(remaining):
             h = by_id[i]
@@ -141,15 +140,37 @@ def check_linearizable(history: list[HOp], model,
             new_state, res = model.apply(state, h.op)
             if h.result is not None and res != h.result:
                 continue
-            order.append(i)
-            if rec(remaining - {i}, new_state):
-                return True
-            order.pop()
-        memo.add(key)
-        return False
+            yield i, remaining - {i}, new_state
 
-    ok = rec(ids, model.init if init_state is None else init_state)
-    return CheckResult(ok=ok, nodes=nodes, witness=list(order))
+    # Explicit stack (NOT recursion: a linearization is one stack frame
+    # per op, and deep verdict histories run thousands of ops — Python's
+    # recursion limit turned them into spurious 'undecided' groups).
+    # Frame = (remaining, state, candidate iterator, owns_order_slot).
+    stack = [(ids, init, candidates(ids, init), False)]
+    while stack:
+        remaining, state, it, owns = stack[-1]
+        advanced = False
+        for i, nr, ns in it:
+            order.append(i)
+            if all_incomplete(nr):
+                return CheckResult(ok=True, nodes=nodes,
+                                   witness=list(order))
+            if (nr, ns) in memo:
+                order.pop()
+                continue
+            nodes += 1
+            if nodes > max_nodes:
+                raise RuntimeError(
+                    f"linearizability search exceeded {max_nodes} nodes")
+            stack.append((nr, ns, candidates(nr, ns), True))
+            advanced = True
+            break
+        if not advanced:
+            memo.add((remaining, state))
+            stack.pop()
+            if owns:
+                order.pop()
+    return CheckResult(ok=False, nodes=nodes, witness=[])
 
 
 def quiescent_segments(history: list[HOp]) -> list[list[HOp]]:

@@ -173,6 +173,23 @@ def test_map_per_key_checker_matches_brute_force():
     assert agree_yes > 40 and agree_no > 40, (agree_yes, agree_no)
 
 
+def test_fully_chained_history_has_no_recursion_limit():
+    """Overlap chains (complete == next invoke) admit NO quiescent cut,
+    so one segment carries every op; the iterative search must handle
+    thousands of ops — the recursive version hit Python's stack limit at
+    ~1k and turned deep verdict groups into spurious 'undecided'."""
+    hist = []
+    state = RegisterModel.init
+    for i in range(3000):
+        op = ("add", 1)
+        state, res = RegisterModel.apply(state, op)
+        hist.append(HOp(op_id=i, op=op, result=res, invoke=i,
+                        complete=i + 1))
+    res = check_linearizable_windowed(hist, RegisterModel)
+    assert res.ok
+    assert res.nodes <= 3000, res.nodes
+
+
 def test_windowed_checker_tractable_on_deep_histories():
     """A 2,000-op low-concurrency history (the verdict's new per-group
     depth) must check in ~linear nodes — the monolithic search's windows
