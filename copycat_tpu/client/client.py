@@ -152,6 +152,12 @@ class RaftClient(Managed):
         # event-loop turn); a lone submit still rides CommandRequest.
         self._pending_batch: list = []
         self._batch_scheduled = False
+        # Query micro-batching: same-turn reads bucket by consistency
+        # level (the server's gate differs per level) and ride one
+        # QueryBatchRequest — the linearizable gate's quorum round is
+        # amortized over the whole batch.
+        self._pending_queries: dict[str, list] = {}
+        self._query_flush_scheduled = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -381,12 +387,82 @@ class RaftClient(Managed):
     async def _submit_query(self, operation: Query) -> Any:
         if not self._session.is_open:
             raise SessionExpiredError("session is not open")
-        consistency = operation.consistency()
-        response = await self._request(
-            msg.QueryRequest(session_id=self._session.id, index=self._index,
-                             operation=operation, consistency=consistency.value),
-            leader_required=consistency.value in ("linearizable", "bounded_linearizable"))
-        return self._finish(response, None)
+        consistency = operation.consistency().value
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pending_queries.setdefault(consistency, []).append(
+            (operation, fut))
+        if not self._query_flush_scheduled:
+            self._query_flush_scheduled = True
+            loop.call_soon(self._launch_query_batches)
+        return await fut
+
+    def _launch_query_batches(self) -> None:
+        self._query_flush_scheduled = False
+        pending, self._pending_queries = self._pending_queries, {}
+        for consistency, items in pending.items():
+            if items:
+                spawn(self._flush_query_batch(consistency, items),
+                      name="query-batch")
+
+    async def _flush_query_batch(self, consistency: str,
+                                 items: list) -> None:
+        leader_required = consistency in ("linearizable",
+                                          "bounded_linearizable")
+        if len(items) == 1:
+            operation, fut = items[0]
+            try:
+                response = await self._request(
+                    msg.QueryRequest(session_id=self._session.id,
+                                     index=self._index, operation=operation,
+                                     consistency=consistency),
+                    leader_required=leader_required)
+                result = self._finish(response, None)
+            except BaseException as e:  # noqa: BLE001 — delivered via fut
+                if not fut.done():
+                    fut.set_exception(e)
+                return
+            if not fut.done():
+                fut.set_result(result)
+            return
+        try:
+            response = await self._request(
+                msg.QueryBatchRequest(
+                    session_id=self._session.id, index=self._index,
+                    consistency=consistency,
+                    operations=[op for op, _ in items]),
+                leader_required=leader_required)
+            if getattr(response, "error", None):
+                self._finish(response, None)  # raises the right exception
+        except BaseException as e:  # noqa: BLE001
+            for _, fut in items:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        try:
+            if response.index:
+                self._index = max(self._index, response.index)
+            entries = response.entries or []
+            for k, (operation, fut) in enumerate(items):
+                if fut.done():
+                    continue
+                if k >= len(entries):
+                    fut.set_exception(msg.ProtocolError(
+                        msg.INTERNAL, "missing batch query entry"))
+                    continue
+                result, code, detail = entries[k]
+                if code == msg.APPLICATION:
+                    fut.set_exception(
+                        ApplicationError(detail or "application error"))
+                elif code:
+                    fut.set_exception(msg.ProtocolError(code, detail or ""))
+                else:
+                    fut.set_result(result)
+        except BaseException as e:  # noqa: BLE001 — no caller may hang
+            for _, fut in items:
+                if not fut.done():
+                    fut.set_exception(e)
+            raise
 
     def _finish(self, response: Any, seq: int | None) -> Any:
         error = getattr(response, "error", None)

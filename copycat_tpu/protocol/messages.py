@@ -151,6 +151,24 @@ class CommandBatchResponse(Response):
     _fields = ("error", "error_detail", "leader", "event_index", "entries")
 
 
+@serialize_with(226)
+class QueryBatchRequest(Message):
+    """Micro-batched reads of ONE consistency level: the server performs
+    the consistency gate (leadership confirmation / applied-index wait)
+    once for the whole batch — for LINEARIZABLE reads that amortizes a
+    quorum round over N queries. ``operations`` positional."""
+
+    _fields = ("session_id", "index", "consistency", "operations")
+
+
+@serialize_with(227)
+class QueryBatchResponse(Response):
+    """``entries`` positional with the request: [(result, error_code,
+    error_detail), ...]."""
+
+    _fields = ("error", "error_detail", "leader", "index", "entries")
+
+
 @serialize_with(210)
 class PublishRequest(Message):
     """Server -> client event push (session event channel).

@@ -263,6 +263,7 @@ class RaftServer(Managed):
         connection.handler(msg.CommandBatchRequest,
                            lambda m: self._on_command_batch(connection, m))
         connection.handler(msg.QueryRequest, self._on_query)
+        connection.handler(msg.QueryBatchRequest, self._on_query_batch)
         connection.handler(msg.JoinRequest, self._on_join)
         connection.handler(msg.LeaveRequest, self._on_leave)
 
@@ -890,26 +891,38 @@ class RaftServer(Managed):
         return msg.CommandResponse(index=index, result=result,
                                    event_index=session.event_index)
 
-    async def _on_query(self, request: msg.QueryRequest) -> msg.QueryResponse:
-        consistency = QueryConsistency(request.consistency or "linearizable")
-        if consistency in (QueryConsistency.LINEARIZABLE, QueryConsistency.BOUNDED_LINEARIZABLE):
+    async def _gate_query(self, consistency: QueryConsistency,
+                          client_index: int) -> tuple[str, str] | None:
+        """Consistency-dependent serving precondition; (code, detail) on
+        refusal, None once this server may serve at ``last_applied``."""
+        if consistency in (QueryConsistency.LINEARIZABLE,
+                           QueryConsistency.BOUNDED_LINEARIZABLE):
             if self.role != LEADER:
-                return self._not_leader(msg.QueryResponse)
+                return (msg.NOT_LEADER, "")
             if consistency is QueryConsistency.LINEARIZABLE:
                 if not await self._confirm_leadership():
-                    return self._not_leader(msg.QueryResponse)
+                    return (msg.NOT_LEADER, "")
             elif not self._lease_valid():
                 if not await self._confirm_leadership():
-                    return self._not_leader(msg.QueryResponse)
+                    return (msg.NOT_LEADER, "")
             # Serve at the latest committed state.
             await self._wait_applied(self.commit_index)
         else:
             # SEQUENTIAL / CAUSAL: any server, at or after the client's index.
-            want = request.index or 0
-            ok = await self._wait_applied(want, timeout=self.election_timeout * 4)
+            ok = await self._wait_applied(client_index or 0,
+                                          timeout=self.election_timeout * 4)
             if not ok:
-                return msg.QueryResponse(error=msg.INTERNAL,
-                                         error_detail="state lagging behind client index")
+                return (msg.INTERNAL, "state lagging behind client index")
+        return None
+
+    async def _on_query(self, request: msg.QueryRequest) -> msg.QueryResponse:
+        consistency = QueryConsistency(request.consistency or "linearizable")
+        refused = await self._gate_query(consistency, request.index or 0)
+        if refused is not None:
+            code, detail = refused
+            if code == msg.NOT_LEADER:
+                return self._not_leader(msg.QueryResponse)
+            return msg.QueryResponse(error=code, error_detail=detail)
         session = self.sessions.get(request.session_id)
         commit = Commit(self.last_applied, session, self.context.clock,
                         request.operation, None)
@@ -921,6 +934,32 @@ class RaftServer(Managed):
         finally:
             commit.close()
         return msg.QueryResponse(index=self.last_applied, result=result)
+
+    async def _on_query_batch(self, request: msg.QueryBatchRequest
+                              ) -> msg.QueryBatchResponse:
+        """Batched reads of one consistency level: the gate (leadership
+        confirmation / applied wait) runs ONCE for the whole batch — a
+        quorum round amortized over N linearizable reads."""
+        consistency = QueryConsistency(request.consistency or "linearizable")
+        refused = await self._gate_query(consistency, request.index or 0)
+        if refused is not None:
+            code, detail = refused
+            if code == msg.NOT_LEADER:
+                return self._not_leader(msg.QueryBatchResponse)
+            return msg.QueryBatchResponse(error=code, error_detail=detail)
+        session = self.sessions.get(request.session_id)
+        entries = []
+        for operation in (request.operations or []):
+            commit = Commit(self.last_applied, session, self.context.clock,
+                            operation, None)
+            try:
+                entries.append((self.executor.execute(commit), None, None))
+            except Exception as e:  # noqa: BLE001 — per-entry app errors
+                entries.append((None, msg.APPLICATION, str(e)))
+            finally:
+                commit.close()
+        return msg.QueryBatchResponse(index=self.last_applied,
+                                      entries=entries)
 
     async def _wait_applied(self, index: int, timeout: float | None = None) -> bool:
         deadline = (time.monotonic() + timeout) if timeout else None

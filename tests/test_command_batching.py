@@ -127,6 +127,23 @@ async def test_batching_across_three_replicas():
         await _teardown([client] + servers)
 
 
+@async_test(timeout=120)
+async def test_concurrent_queries_coalesce_per_consistency():
+    servers, client = await _cluster()
+    try:
+        values = await asyncio.gather(
+            *(client.get(f"v{i}", DistributedAtomicValue) for i in range(10)))
+        await asyncio.gather(*(v.set(i) for i, v in enumerate(values)))
+        counts = _spy_requests(client)
+        got = await asyncio.gather(*(v.get() for v in values))
+        assert got == list(range(10))
+        # one linearizable-read gate for the whole turn, not ten
+        assert counts.get("QueryBatchRequest", 0) >= 1, counts
+        assert counts.get("QueryRequest", 0) == 0, counts
+    finally:
+        await _teardown([client] + servers)
+
+
 @async_test(timeout=180)
 async def test_batched_submits_survive_leader_failover():
     """Concurrent (batched) submits during a leader loss must re-route
