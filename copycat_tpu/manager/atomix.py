@@ -49,9 +49,20 @@ class Atomix(Managed):
             return cached
         machine = resource_state_machine_of(resource_type)
         instance_id = await self.client.submit(GetResource(key, machine))
-        resource = resource_type(InstanceClient(instance_id, self.client))
+        resource = resource_type(InstanceClient(
+            instance_id, self.client,
+            on_delete=lambda: self._evict(key, instance_id)))
         self._resources[key] = resource
         return resource
+
+    def _evict(self, key: str, instance_id: int) -> None:
+        """Drop the get() singleton for a deleted resource (only if the
+        cache still holds THAT instance — a re-created resource under the
+        same key must not be evicted by a stale facade's delete)."""
+        cached = self._resources.get(key)
+        if cached is not None and getattr(cached.client, "instance_id",
+                                          None) == instance_id:
+            del self._resources[key]
 
     async def create(self, key: str, resource_type: Type[R]) -> R:
         """Fresh instance with its own virtual session per call

@@ -74,10 +74,15 @@ class InstanceSession:
 class InstanceClient:
     """RaftClient facade routing every op to one resource instance."""
 
-    def __init__(self, instance_id: int, client: RaftClient) -> None:
+    def __init__(self, instance_id: int, client: RaftClient,
+                 on_delete=None) -> None:
         self.instance_id = instance_id
         self.client = client
         self._session = InstanceSession(instance_id, client.session())
+        # notifies the owning Atomix facade so its get() singleton cache
+        # drops the key — a later get() must create a FRESH resource, not
+        # hand back a facade whose server-side instance is gone
+        self._on_delete = on_delete
 
     def session(self) -> InstanceSession:
         return self._session
@@ -90,6 +95,8 @@ class InstanceClient:
                 InstanceCommand(self.instance_id, operation))
             await self.client.submit(DeleteResource(self.instance_id))
             self._session.close()
+            if self._on_delete is not None:
+                self._on_delete()
             return result
         if isinstance(operation, Query):
             return await self.client.submit(InstanceQuery(self.instance_id, operation))
