@@ -571,8 +571,16 @@ def run_spi() -> dict:
 
 
 def run_election() -> dict:
-    """Config #2: forced leader churn; measures elections completed/sec."""
+    """Config #2: forced leader churn; measures elections completed/sec.
+
+    Election timeout knobs (COPYCAT_BENCH_TIMER_MIN/MAX) default to the
+    engine's 4-9 here so the number stays comparable across rounds;
+    shorter timers complete forced elections proportionally faster."""
     config = Config(use_pallas=use_pallas(),
+                    timer_min=int(os.environ.get(
+                        "COPYCAT_BENCH_TIMER_MIN", "4")),
+                    timer_max=int(os.environ.get(
+                        "COPYCAT_BENCH_TIMER_MAX", "9")),
                     resource=RESOURCE_CONFIGS["election"])
     key = jax.random.PRNGKey(0)
     key, init_key = jax.random.split(key)
