@@ -13,6 +13,9 @@ _I16 = struct.Struct(">h")
 _I32 = struct.Struct(">i")
 _I64 = struct.Struct(">q")
 _F64 = struct.Struct(">d")
+# single-byte interning: most wire integers (type tags, small ids, field
+# counts) zigzag-encode to one byte — skip the per-byte encode loop
+_ONE = [bytes((v,)) for v in range(256)]
 
 
 class BufferOutput:
@@ -22,7 +25,7 @@ class BufferOutput:
         self._parts: list[bytes] = []
 
     def write_u8(self, value: int) -> "BufferOutput":
-        self._parts.append(bytes((value & 0xFF,)))
+        self._parts.append(_ONE[value & 0xFF])
         return self
 
     def write_bool(self, value: bool) -> "BufferOutput":
@@ -47,6 +50,9 @@ class BufferOutput:
     def write_varint(self, value: int) -> "BufferOutput":
         """ZigZag-encoded LEB128 varint (handles negatives compactly)."""
         zz = ((-value) << 1) - 1 if value < 0 else (value << 1)
+        if zz < 0x80:  # one-byte fast path (the overwhelmingly common case)
+            self._parts.append(_ONE[zz])
+            return self
         out = bytearray()
         while True:
             byte = zz & 0x7F
@@ -114,10 +120,13 @@ class BufferInput:
         return _F64.unpack(self._take(8))[0]
 
     def read_varint(self) -> int:
-        shift = 0
-        zz = 0
+        first = self._take(1)[0]
+        if not first & 0x80:  # one-byte fast path
+            return -((first + 1) >> 1) if first & 1 else first >> 1
+        zz = first & 0x7F
+        shift = 7
         while True:
-            byte = self.read_u8()
+            byte = self._take(1)[0]
             zz |= (byte & 0x7F) << shift
             if not byte & 0x80:
                 break
