@@ -404,19 +404,21 @@ def run_host() -> dict:
     side."""
     from .models import BulkDriver, RaftGroups
 
-    mode = os.environ.get("COPYCAT_BENCH_HOST_MODE", "bulk")
-    if mode not in ("bulk", "queued"):
-        raise SystemExit(f"COPYCAT_BENCH_HOST_MODE={mode!r}: bulk|queued")
+    mode = os.environ.get("COPYCAT_BENCH_HOST_MODE", "deep")
+    if mode not in ("deep", "bulk", "queued"):
+        raise SystemExit(
+            f"COPYCAT_BENCH_HOST_MODE={mode!r}: deep|bulk|queued")
     rg = RaftGroups(GROUPS, PEERS, log_slots=LOG_SLOTS,
                     submit_slots=SUBMIT_SLOTS,
                     config=Config(use_pallas=use_pallas(),
                                   append_window=max(4, SUBMIT_SLOTS),
                                   applies_per_round=max(4, SUBMIT_SLOTS),
                                   pool_budgets=POOL_BUDGETS,
-                                  resource=RESOURCE_CONFIGS["counter"]))
+                                  resource=RESOURCE_CONFIGS["counter"],
+                                  monotone_tag_accept=(mode == "deep")))
     per_group = int(os.environ.get(
         "COPYCAT_BENCH_HOST_BURST",
-        str(SUBMIT_SLOTS * (8 if mode == "bulk" else 1))))
+        str(SUBMIT_SLOTS * (8 if mode != "queued" else 1))))
     log(f"bench[host:{mode}]: G={GROUPS} P={PEERS} {per_group} "
         f"ops/group/burst; device={jax.devices()[0].platform}")
     rg.wait_for_leaders()
@@ -426,7 +428,7 @@ def run_host() -> dict:
     lat_p50 = lat_p99 = 0.0
 
     def burst() -> tuple[float, dict | None]:
-        if mode == "bulk":
+        if mode != "queued":
             res = driver.drive(groups, ap.OP_LONG_ADD, 1)
             return groups.size / res.wall_s, res.latency_percentiles_ms()
         t0 = time.perf_counter()
@@ -448,13 +450,14 @@ def run_host() -> dict:
             f"ops/sec host-observed")
     out = {
         "metric": (f"host_observed_committed_ops_per_sec_{GROUPS}_groups"
-                   + ("" if mode == "bulk" else "_queued")),
+                   + {"deep": "", "bulk": "_sync",
+                      "queued": "_queued"}[mode]),
         "value": round(best, 1),
         "unit": "ops/sec",
         "vs_baseline": round(best / NORTH_STAR_OPS, 4),
         **spread(reps),
     }
-    if mode == "bulk":
+    if mode != "queued":
         # client-observed submit->result latency (ms, best-rep cadence)
         out["p50_latency_ms"] = round(lat_p50, 3)
         out["p99_latency_ms"] = round(lat_p99, 3)

@@ -150,6 +150,11 @@ class RaftGroups:
         self.events: dict[int, list[tuple[int, int, int, int]]] = {}
         self._ev_seen: dict[int, int] = {}   # group -> highest seq consumed
         self._sessions: Any = None           # lazy DeviceSessionRegistry
+        # monotone-tag engines: per-group count of stream ops committed so
+        # far — the next drive's dense tags continue from here (the device
+        # gate tracks the same value as the max live-ring tag)
+        if self.config.monotone_tag_accept:
+            self._stream_count = np.zeros(num_groups, np.int64)
 
     @property
     def sessions(self):
@@ -171,6 +176,18 @@ class RaftGroups:
                        tag=np.zeros((G, S), np.int32),
                        valid=np.zeros((G, S), bool))
 
+    def _refuse_monotone(self) -> None:
+        """Monotone-tag engines (``Config.monotone_tag_accept``) accept only
+        the bulk plane's dense per-group tag streams — a queue-managed
+        submit (whose retries re-send OLD tags) would be silently rejected
+        by the device gate forever, so refuse it loudly up front. Queries
+        never append and stay allowed."""
+        if self.config.monotone_tag_accept:
+            raise NotImplementedError(
+                "queue-managed submits are incompatible with "
+                "Config(monotone_tag_accept=True) engines; drive them "
+                "through models.bulk.BulkDriver")
+
     def submit(self, group: int, opcode: int, a: int = 0, b: int = 0,
                c: int = 0) -> int:
         """Queue one op; returns a correlation tag resolved in ``results``."""
@@ -185,6 +202,7 @@ class RaftGroups:
             if not 0 <= a < self.num_peers:
                 raise ValueError(
                     f"peer {a} outside 0..{self.num_peers - 1}")
+        self._refuse_monotone()
         tag = self._next_tag
         self._next_tag += 1
         self._queues.setdefault(group, deque()).append((opcode, a, b, c, tag))
@@ -427,10 +445,21 @@ class RaftGroups:
                     self.results[tag] = int(results[g, s])
                     done.inc()
             else:
-                # escalate: re-enter as a command (quorum-committed read —
-                # always at least as strong as the requested level)
                 op = (int(sub.opcode[g, s]), int(sub.a[g, s]),
                       int(sub.b[g, s]), int(sub.c[g, s]))
+                if self.config.monotone_tag_accept:
+                    # the command path is closed on monotone-tag engines
+                    # (the gate would reject the escalated tag forever) —
+                    # retry on the query lane instead; it becomes
+                    # servable once a leader/lease settles
+                    self._query_queues.setdefault(g, deque()).append(
+                        (*op, tag))
+                    if atomic[g, s]:
+                        self._query_atomic.add(tag)
+                    fell_back.inc()
+                    continue
+                # escalate: re-enter as a command (quorum-committed read —
+                # always at least as strong as the requested level)
                 self._queues.setdefault(g, deque()).append((*op, tag))
                 self._inflight_ops[tag] = op  # joins the loss-retry protocol
                 fell_back.inc()
@@ -638,6 +667,7 @@ class RaftGroups:
         if any(o in (OP_CFG_ADD, OP_CFG_REMOVE) for o in set(op_l)):
             raise ValueError("membership changes go through "
                              "add_peer/remove_peer, not submit_batch")
+        self._refuse_monotone()
         tags = np.arange(self._next_tag, self._next_tag + n)
         if n == 0:
             return tags

@@ -209,6 +209,28 @@ class Config(NamedTuple):
     # land on a live leader within ~an election of the fault, pulling
     # the tail to the election timescale at unchanged throughput.
     lease_gated_accept: bool = True
+    # Device-enforced per-group FIFO + dedup for the bulk data plane
+    # (models/bulk.py deep pipeline): a submit is accepted only when its
+    # tag is EXACTLY (max live-ring tag of the leader log) + 1 + (its
+    # rank among this window's valid slots) — i.e. tags must arrive as a
+    # dense monotone per-group sequence (1, 2, 3, ...). Duplicates
+    # (tag <= ring max) and out-of-order futures are rejected, so the
+    # host may re-send ANY unresolved op at ANY time without risking
+    # double-apply — the device-side analogue of the reference client's
+    # session command sequencing (Copycat client, SURVEY §2.3), derived
+    # entirely from the replicated log (election no-ops carry tag 0 and
+    # never disturb the max; no new replicated state). Safety
+    # (exactly-once) is UNCONDITIONAL: a duplicate whose original still
+    # sits in any electable log is rejected, because either the original
+    # is inside the ring window (max >= tag) or >= L newer higher-tag
+    # stream entries scrolled past it (max > tag); acceptance therefore
+    # implies the original can never commit. Liveness under leader
+    # churn can wedge on truncated-slot tag inflation — engines with
+    # this flag are bulk-plane engines (fault-free delivery), and the
+    # driver surfaces a TimeoutError rather than stalling silently.
+    # Queue-managed submits (retries of old tags) are incompatible;
+    # RaftGroups refuses them on monotone engines.
+    monotone_tag_accept: bool = False
 
 
 def init_state(num_groups: int, num_peers: int, log_slots: int,
@@ -437,6 +459,30 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
     peer_ids = jnp.arange(P)
     g_ids = jnp.arange(G)
 
+    # Submit-leaf normalization: hosts behind a high-latency transport
+    # (the tunneled TPU) shrink H2D bytes by passing COMPACT leaves —
+    # a Python/numpy scalar for a burst-uniform opcode/payload (zero
+    # transfer), or for ``tag`` a [G,1] column meaning "this base tag at
+    # slot 0, consecutive at later slots" (the deep bulk plane's dense
+    # per-group streams, models/bulk.py — 16x fewer tag bytes). ``valid``
+    # is always a full [G,S] bool array and defines S. Full [G,S] arrays
+    # pass through untouched, so every existing caller is unchanged.
+    S_sub = submits.valid.shape[-1]
+
+    def _norm(x):
+        x = jnp.asarray(x, jnp.int32)
+        return x if x.shape == (G, S_sub) \
+            else jnp.broadcast_to(x, (G, S_sub))
+
+    tag_n = jnp.asarray(submits.tag, jnp.int32)
+    if tag_n.ndim == 2 and tag_n.shape == (G, 1) and S_sub != 1:
+        tag_n = tag_n + jnp.arange(S_sub, dtype=jnp.int32)[None, :]
+    else:
+        tag_n = _norm(tag_n)
+    submits = submits._replace(
+        opcode=_norm(submits.opcode), a=_norm(submits.a),
+        b=_norm(submits.b), c=_norm(submits.c), tag=tag_n)
+
     # Replicated logical clock: +1 per step in every lane, so entry
     # timestamps (and thus TTL/timeout evaluation) are identical on every
     # replica (SURVEY.md §7.3 #3 — never wall clock inside the kernel).
@@ -554,6 +600,25 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
         # completion (the session program order _harvest preserves).
         valid = valid & (jnp.cumsum(cfg_rejected.astype(jnp.int32),
                                     axis=1) == 0)
+    if config.monotone_tag_accept:
+        # Max stream tag in the leader log's LIVE ring window. Slot j's
+        # resident index is the unique idx in (last-L, last] with
+        # (idx-1) % L == j; slots outside the window (never appended, or
+        # beyond a truncated last) are masked out. Election no-ops carry
+        # tag 0 and stream tags start at 1, so max==0 means "no stream
+        # entry yet".
+        j_ids = jnp.arange(L, dtype=jnp.int32)[None, :]
+        idx_at = l_last[:, None] - ((l_last[:, None] - (j_ids + 1)) % L)
+        in_log = (idx_at >= 1) & (idx_at <= l_last[:, None])
+        last_stream = jnp.max(jnp.where(in_log, l_log_tag, 0), axis=1)
+        vi = valid.astype(jnp.int32)
+        rank = jnp.cumsum(vi, axis=1) - vi       # rank among valid slots
+        gate_ok = submits.tag == last_stream[:, None] + 1 + rank
+        # suffix-reject from the first gate failure keeps acceptance
+        # hole-free (same discipline as backpressure/config rejects)
+        gate_fail = valid & ~gate_ok
+        valid = valid & gate_ok & (jnp.cumsum(
+            gate_fail.astype(jnp.int32), axis=1) == 0)
     pos = l_last[:, None] + jnp.cumsum(valid.astype(jnp.int32), axis=1)
     accepted = valid & (pos <= allowed_last[:, None])
     # One-hot scatter per log array: accepted slots are distinct within a
@@ -926,3 +991,43 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
             jnp.where(role_f == LEADER, new_state.term, -1), axis=1),
         refused=refused if dyn else jnp.zeros_like(submits.valid))
     return new_state, outputs
+
+
+def deep_step(state: RaftState, resbuf: jnp.ndarray, valbuf: jnp.ndarray,
+              rndbuf: jnp.ndarray, evflag: jnp.ndarray, base: jnp.ndarray,
+              rnd: jnp.ndarray, submits: Submits, deliver: jnp.ndarray,
+              key: jax.Array, config: Config
+              ) -> tuple[RaftState, jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                         jnp.ndarray, StepOutputs]:
+    """One consensus round + ON-DEVICE result accumulation (deep bulk plane).
+
+    The deep pipelined driver (``models/bulk.py``) commits dense
+    per-group tag streams (``Config.monotone_tag_accept``), so an applied
+    result's stream rank is ``out_tag - 1 - base[g]`` — this wrapper
+    scatters each round's applied results/resolve-rounds into carried
+    ``[G, B]`` buffers keyed by that rank. The host then fetches ONE
+    buffer set per drive instead of per-round out arrays: through a
+    tunneled accelerator (~tens of ms per blocking D2H) that is the
+    difference between per-round and per-drive transfer cost (round-4
+    host-scenario profile: transfers were ~90% of wall time).
+
+    ``rndbuf`` keeps the EARLIEST resolve round per op (``.min`` scatter)
+    so at-least-once re-reports never inflate client latency. ``evflag``
+    carries "any session event drained so far" — the host checks one
+    scalar and fetches per-round event leaves only on the rare path.
+    Reports for tags outside [base+1, base+B] (earlier drives, election
+    no-ops) fall on the ``mode="drop"`` sentinel column.
+    """
+    state, out = step(state, submits, deliver, key, config=config)
+    G = out.out_tag.shape[0]
+    B = resbuf.shape[1]
+    k = out.out_tag - 1 - base[:, None]
+    k = jnp.where(out.out_valid & (k >= 0) & (k < B), k, B)  # B = drop
+    g_ids = jnp.arange(G, dtype=jnp.int32)[:, None]
+    resbuf = resbuf.at[g_ids, k].set(out.out_result, mode="drop")
+    rnd_full = jnp.broadcast_to(jnp.asarray(rnd, jnp.int32),
+                                out.out_tag.shape)
+    rndbuf = rndbuf.at[g_ids, k].min(rnd_full, mode="drop")
+    valbuf = valbuf.at[g_ids, k].set(True, mode="drop")
+    evflag = evflag | out.ev_valid.any()
+    return state, resbuf, valbuf, rndbuf, evflag, out
