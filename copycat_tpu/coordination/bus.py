@@ -12,29 +12,23 @@ import asyncio
 import itertools
 from typing import Any, Callable
 
-from ..io.buffer import BufferInput, BufferOutput
-from ..io.serializer import Serializer, serialize_with
+from ..io.serializer import serialize_with
 from ..io.transport import Address, Connection, Transport, TransportError
+from ..protocol.messages import Message as _WireMessage
 from ..resource.resource import AbstractResource, resource_info
 from . import commands as c
 from .state import MessageBusState
 
 
 @serialize_with(108)
-class Message:
+class Message(_WireMessage):
     """(topic, body) value type (reference ``Message.java:30``)."""
+
+    _fields = ("topic", "body")
 
     def __init__(self, topic: str = "", body: Any = None) -> None:
         self.topic = topic
         self.body = body
-
-    def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
-        buf.write_utf8(self.topic)
-        serializer.write_object(self.body, buf)
-
-    def read_object(self, buf: BufferInput, serializer: Serializer) -> None:
-        self.topic = buf.read_utf8()
-        self.body = serializer.read_object(buf)
 
 
 class MessageProducer:

@@ -48,6 +48,23 @@ class CatalystSerializable(Protocol):
 
 _TYPE_REGISTRY: dict[int, type] = {}
 _ID_BY_TYPE: dict[type, int] = {}
+#: type_id -> tuple of field names for classes whose write/read is the
+#: GENERIC field-list form (both methods carry the ``_generic_fields``
+#: marker set by protocol.messages.Message), else None. The native codec
+#: (io/codec.py) serializes generic classes entirely in C; None means it
+#: calls back into the class's custom write_object/read_object.
+_CODEC_FIELDS: dict[int, tuple | None] = {}
+
+
+def _generic_fields(cls: type) -> tuple | None:
+    w = getattr(cls, "write_object", None)
+    r = getattr(cls, "read_object", None)
+    if getattr(w, "_generic_fields", False) \
+            and getattr(r, "_generic_fields", False):
+        fields = getattr(cls, "_fields", None)
+        if fields is not None:
+            return tuple(fields)
+    return None
 
 
 def serialize_with(type_id: int) -> Callable[[type], type]:
@@ -62,6 +79,7 @@ def serialize_with(type_id: int) -> Callable[[type], type]:
             raise ValueError(f"serialization id {type_id} already bound to {check!r}")
         _TYPE_REGISTRY[type_id] = cls
         _ID_BY_TYPE[cls] = type_id
+        _CODEC_FIELDS[type_id] = _generic_fields(cls)
         return cls
 
     return register
@@ -75,15 +93,41 @@ class SerializationError(Exception):
     pass
 
 
+def _native() -> Any:
+    """Lazy import breaks the codec<->serializer import cycle."""
+    from .codec import codec
+    return codec()
+
+
 class Serializer:
-    """Writes/reads arbitrary object graphs of primitives + registered types."""
+    """Writes/reads arbitrary object graphs of primitives + registered types.
+
+    ``write``/``read`` prefer the native codec (io/codec.py, a
+    byte-identical C walk of the same format) and fall back to the pure
+    Python below on ``Fallback`` (>64-bit ints) or when the extension
+    is unavailable. ``write_object``/``read_object`` ARE the format's
+    reference implementation — custom-serialized classes re-enter
+    through them from the native side too.
+    """
 
     def write(self, obj: Any) -> bytes:
+        c = _native()
+        if c is not None:
+            try:
+                return c.encode(obj)
+            except c.Fallback:
+                pass
         buf = BufferOutput()
         self.write_object(obj, buf)
         return buf.to_bytes()
 
     def read(self, data: bytes) -> Any:
+        c = _native()
+        if c is not None:
+            try:
+                return c.decode(bytes(data))
+            except c.Fallback:
+                pass
         return self.read_object(BufferInput(data))
 
     # -- object graph ------------------------------------------------------

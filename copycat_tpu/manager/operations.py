@@ -7,34 +7,36 @@
 - Envelope ops route an operation to a resource instance: ``InstanceCommand``
   (30) / ``InstanceQuery`` (31); ``InstanceEvent`` (32) routes session events
   back, filtered client-side by instance id.
+
+All are generic field-list serializable (``Message``), so the native
+codec walks instance envelopes — the wrapper around every routed op —
+entirely in C.
 """
 
 from __future__ import annotations
 
 from typing import Any
 
-from ..io.buffer import BufferInput, BufferOutput
-from ..io.serializer import Serializer, serialize_with
+from ..io.serializer import serialize_with
+from ..protocol.messages import Message
 from ..protocol.operations import Command, CommandConsistency, Persistence, Query, QueryConsistency
 
 
-class KeyOperation:
+class KeyOperation(Message):
     """Base for catalog ops addressing a resource by name (``KeyOperation.java``)."""
+
+    _fields = ("key",)
 
     def __init__(self, key: str = "") -> None:
         self.key = key
-
-    def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
-        buf.write_utf8(self.key)
-
-    def read_object(self, buf: BufferInput, serializer: Serializer) -> None:
-        self.key = buf.read_utf8()
 
 
 @serialize_with(35)
 class GetResource(KeyOperation, Command):
     """Get-or-create the resource and attach (at most) one instance per client
     session; returns the instance id."""
+
+    _fields = ("key", "state_machine")
 
     def __init__(self, key: str = "", state_machine: type | None = None) -> None:
         super().__init__(key)
@@ -43,14 +45,6 @@ class GetResource(KeyOperation, Command):
     def consistency(self) -> CommandConsistency:
         return CommandConsistency.LINEARIZABLE
 
-    def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
-        super().write_object(buf, serializer)
-        serializer.write_class(self.state_machine, buf)
-
-    def read_object(self, buf: BufferInput, serializer: Serializer) -> None:
-        super().read_object(buf, serializer)
-        self.state_machine = serializer.read_object(buf)
-
 
 @serialize_with(36)
 class CreateResource(GetResource):
@@ -58,20 +52,16 @@ class CreateResource(GetResource):
 
 
 @serialize_with(37)
-class DeleteResource(Command):
+class DeleteResource(Message, Command):
     """Deletes a resource's replicated state entirely (by instance id)."""
+
+    _fields = ("instance_id",)
 
     def __init__(self, instance_id: int = 0) -> None:
         self.instance_id = instance_id
 
     def persistence(self) -> Persistence:
         return Persistence.PERSISTENT
-
-    def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
-        buf.write_i64(self.instance_id)
-
-    def read_object(self, buf: BufferInput, serializer: Serializer) -> None:
-        self.instance_id = buf.read_i64()
 
 
 @serialize_with(38)
@@ -80,20 +70,14 @@ class ResourceExists(KeyOperation, Query):
         return QueryConsistency.LINEARIZABLE
 
 
-class InstanceOperation:
+class InstanceOperation(Message):
     """Envelope (instance id, inner operation)."""
+
+    _fields = ("resource", "operation")
 
     def __init__(self, resource: int = 0, operation: Any = None) -> None:
         self.resource = resource
         self.operation = operation
-
-    def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
-        buf.write_i64(self.resource)
-        serializer.write_object(self.operation, buf)
-
-    def read_object(self, buf: BufferInput, serializer: Serializer) -> None:
-        self.resource = buf.read_i64()
-        self.operation = serializer.read_object(buf)
 
 
 @serialize_with(30)
@@ -118,17 +102,11 @@ class InstanceQuery(InstanceOperation, Query):
 
 
 @serialize_with(32)
-class InstanceEvent:
+class InstanceEvent(Message):
     """Event payload envelope: (instance id, message) (``InstanceEvent.java``)."""
+
+    _fields = ("resource", "message")
 
     def __init__(self, resource: int = 0, message: Any = None) -> None:
         self.resource = resource
         self.message = message
-
-    def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
-        buf.write_i64(self.resource)
-        serializer.write_object(self.message, buf)
-
-    def read_object(self, buf: BufferInput, serializer: Serializer) -> None:
-        self.resource = buf.read_i64()
-        self.message = serializer.read_object(buf)

@@ -256,6 +256,27 @@ class BulkDriver:
                           resolve_round=resolve_round)
 
 
+    def _resync_stream_count(self) -> None:
+        """Set each group's stream cursor to the max live-ring tag on the
+        most-advanced lane — every tag at or below it was consumed by the
+        device, so the next drive's dense stream starts just past it.
+        Exact in the deep plane's fault-free world; an error path only
+        (one [G,P,L] fetch)."""
+        rg = self._rg
+        import jax as _jax
+
+        log_tag, last = (np.asarray(x) for x in _jax.device_get(
+            (rg.state.log_tag, rg.state.last_index)))
+        G, P, L = log_tag.shape
+        lane = last.argmax(axis=1)                       # [G]
+        lt = log_tag[np.arange(G), lane]                 # [G,L]
+        ll = last[np.arange(G), lane]                    # [G]
+        j = np.arange(L)[None, :]
+        idx = ll[:, None] - ((ll[:, None] - (j + 1)) % L)
+        in_log = (idx >= 1) & (idx <= ll[:, None])
+        ring_max = np.where(in_log, lt, 0).max(axis=1)
+        rg._stream_count = np.maximum(rg._stream_count, ring_max)
+
     def _drive_deep(self, g_arr, op_a, a_a, b_a, c_a,
                     max_rounds: int, t0: float) -> BulkResult:
         """Zero-sync pipelined drive for monotone-tag engines.
@@ -405,10 +426,18 @@ class BulkDriver:
         while not resolved.all():
             if r > max_rounds:
                 missing = int(n - resolved.sum())
+                # abandoning mid-stream: tags up to the device ring max
+                # were CONSUMED (some abandoned ops may still commit —
+                # at-most-once, like a classic-path timeout). Resync the
+                # host cursor from the device so later drives start past
+                # every consumed tag instead of being gate-rejected
+                # forever (round-4 review finding).
+                self._resync_stream_count()
                 raise TimeoutError(
                     f"bulk drive (deep): {missing} ops unresolved after "
                     f"{max_rounds} rounds (fault-free liveness assumption"
-                    f" violated? use the queue-managed path under faults)")
+                    f" violated? use the queue-managed path under faults); "
+                    f"stream cursors resynced from the device")
             # reduceat on bool would logical-or, not count — cast first
             fu = np.add.reduceat(resolved.astype(np.int64), starts)
             want = np.minimum(counts - fu, S)

@@ -55,6 +55,13 @@ class Message:
         return f"{type(self).__name__}({inner})"
 
 
+# Marker read by serialize_with: classes inheriting these exact function
+# objects serialize as a plain field list, which the native codec
+# (io/codec.py) can walk entirely in C.
+Message.write_object._generic_fields = True
+Message.read_object._generic_fields = True
+
+
 class Response(Message):
     """Base response: ``error`` is an error code, ``leader`` a routing hint."""
 

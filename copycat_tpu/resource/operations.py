@@ -11,25 +11,26 @@ from __future__ import annotations
 
 from typing import Any
 
-from ..io.buffer import BufferInput, BufferOutput
-from ..io.serializer import Serializer, serialize_with
+from ..io.serializer import serialize_with
+from ..protocol.messages import Message
 from ..protocol.operations import Command, CommandConsistency, Persistence, Query, QueryConsistency
 
 
-class ResourceOperation:
-    """Mixin for envelope ops: (operation, consistency-value)."""
+class ResourceOperation(Message):
+    """Mixin for envelope ops: (operation, consistency-value).
+
+    Serialization is the generic field list (byte-identical to the
+    former hand-written write/read) so the native codec walks the
+    envelope in C instead of calling back into Python per wrapped op —
+    the envelope wraps EVERY resource op, so this is the difference
+    between the codec fast path covering ~all of a command batch or
+    almost none of it."""
+
+    _fields = ("operation", "_consistency")
 
     def __init__(self, operation: Any = None, consistency: str | None = None) -> None:
         self.operation = operation
         self._consistency = consistency
-
-    def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
-        serializer.write_object(self.operation, buf)
-        serializer.write_object(self._consistency, buf)
-
-    def read_object(self, buf: BufferInput, serializer: Serializer) -> None:
-        self.operation = serializer.read_object(buf)
-        self._consistency = serializer.read_object(buf)
 
 
 @serialize_with(28)
@@ -70,12 +71,8 @@ class ResourceQuery(ResourceOperation, Query):
 
 
 @serialize_with(34)
-class DeleteCommand(Command):
+class DeleteCommand(Message, Command):
     """Deletes the resource's replicated state (reference
     ``ResourceStateMachine.java:53``)."""
 
-    def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
-        pass
-
-    def read_object(self, buf: BufferInput, serializer: Serializer) -> None:
-        pass
+    _fields = ()

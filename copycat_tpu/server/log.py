@@ -66,7 +66,12 @@ class _MappedSegment:
     #: Nonzero CRC seed: crc32(b"") == 0, so with a zero seed an all-zero
     #: torn frame (header page never written back) would VALIDATE as an
     #: empty frame. Seeding makes all-zero bytes fail the check.
-    CRC_SEED = 0xA5C3
+    #: The seed also doubles as the entry WIRE-FORMAT version stamp: bump
+    #: it whenever serialized entry bytes change shape (last: the round-4
+    #: envelope-class conversion to generic field lists), so segments
+    #: written by an older format fail CRC cleanly at frame 0 and recover
+    #: as empty instead of misparsing old bytes into wrong entries.
+    CRC_SEED = 0xA5C4
 
     def __init__(self, path: str, capacity: int) -> None:
         # Exclusive create: segments are named by the entry index that

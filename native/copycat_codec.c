@@ -1,0 +1,559 @@
+/* Native wire codec: the Catalyst-serializer object graph in C.
+ *
+ * Byte-identical to copycat_tpu/io/serializer.py (the pure-Python
+ * reference implementation and fallback): zigzag-LEB128 varints,
+ * big-endian f64, tagged primitives/containers, registered types as
+ * tag 16+id. Generic field-list classes (protocol.messages.Message
+ * subclasses — the whole session/RPC hot path) are walked entirely in
+ * C; classes with hand-written write_object/read_object round-trip
+ * through Python callbacks registered at configure() time.
+ *
+ * Anything the C path cannot express raises Fallback, and
+ * Serializer.write/read re-runs the pure-Python codec — the native
+ * path is an accelerator, never a semantic fork.
+ *
+ * Reference framing: the reference's serializer is the external
+ * Catalyst jar running on the JVM's JIT; this is the equivalent
+ * native runtime component (SURVEY.md section 2.3 "serialization").
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <string.h>
+
+/* wire tags (serializer.py) */
+#define T_NULL 0
+#define T_TRUE 1
+#define T_FALSE 2
+#define T_INT 3
+#define T_FLOAT 4
+#define T_STR 5
+#define T_BYTES 6
+#define T_LIST 7
+#define T_DICT 8
+#define T_TUPLE 9
+#define T_SET 10
+#define T_CLASS 11
+
+/* module state: live dicts owned by serializer.py + callbacks */
+static PyObject *g_id_by_type;   /* dict: type -> int */
+static PyObject *g_type_by_id;   /* dict: int -> type */
+static PyObject *g_fields_by_id; /* dict: int -> tuple[str] | None */
+static PyObject *g_encode_body;  /* callable(obj) -> bytes (custom types) */
+static PyObject *g_decode_body;  /* callable(cls, bytes, pos) -> (obj, pos) */
+static PyObject *g_fallback;     /* exception type */
+
+/* ------------------------------------------------------------------ */
+/* writer                                                              */
+
+typedef struct {
+    unsigned char *buf;
+    Py_ssize_t len, cap;
+} Writer;
+
+static int w_reserve(Writer *w, Py_ssize_t extra) {
+    if (w->len + extra <= w->cap) return 0;
+    Py_ssize_t cap = w->cap ? w->cap : 256;
+    while (cap < w->len + extra) cap *= 2;
+    unsigned char *nb = PyMem_Realloc(w->buf, cap);
+    if (!nb) { PyErr_NoMemory(); return -1; }
+    w->buf = nb;
+    w->cap = cap;
+    return 0;
+}
+
+static int w_raw(Writer *w, const void *p, Py_ssize_t n) {
+    if (w_reserve(w, n) < 0) return -1;
+    memcpy(w->buf + w->len, p, n);
+    w->len += n;
+    return 0;
+}
+
+/* LEB128 of an already-zigzagged value */
+static int w_uvarint(Writer *w, unsigned long long zz) {
+    if (w_reserve(w, 10) < 0) return -1;
+    while (zz >= 0x80) {
+        w->buf[w->len++] = (unsigned char)(zz & 0x7F) | 0x80;
+        zz >>= 7;
+    }
+    w->buf[w->len++] = (unsigned char)zz;
+    return 0;
+}
+
+static int w_varint(Writer *w, long long v) {
+    unsigned long long zz =
+        ((unsigned long long)v << 1) ^ (unsigned long long)(v >> 63);
+    return w_uvarint(w, zz);
+}
+
+static int w_f64(Writer *w, double d) {
+    union { double d; unsigned long long u; } x;
+    x.d = d;
+    unsigned char be[8];
+    for (int i = 0; i < 8; i++) be[i] = (unsigned char)(x.u >> (56 - 8 * i));
+    return w_raw(w, be, 8);
+}
+
+/* ------------------------------------------------------------------ */
+/* reader                                                              */
+
+typedef struct {
+    const unsigned char *data;
+    Py_ssize_t len, pos;
+    PyObject *source; /* bytes object backing `data` (borrowed) */
+} Reader;
+
+static int r_need(Reader *r, Py_ssize_t n) {
+    /* `pos + n` could overflow for a crafted length varint — compare
+     * against the remaining bytes instead (r->len - r->pos never
+     * overflows); reject negative n here too, belt and braces */
+    if (n < 0 || n > r->len - r->pos) {
+        PyErr_Format(PyExc_EOFError, "buffer underflow: need %zd at %zd/%zd",
+                     n, r->pos, r->len);
+        return -1;
+    }
+    return 0;
+}
+
+/* returns 0 on success; *out = decoded (un-zigzagged) value. Overflowing
+ * 64 zigzag bits raises Fallback (arbitrary-precision ints take the
+ * pure-Python path). */
+static int r_varint(Reader *r, long long *out) {
+    unsigned long long zz = 0;
+    int shift = 0;
+    for (;;) {
+        if (r_need(r, 1) < 0) return -1;
+        unsigned char b = r->data[r->pos++];
+        unsigned long long chunk = b & 0x7F;
+        if (shift > 63 || (shift == 63 && chunk > 1)) {
+            PyErr_SetString(g_fallback, "varint exceeds 64 bits");
+            return -1;
+        }
+        zz |= chunk << shift;
+        if (!(b & 0x80)) break;
+        shift += 7;
+    }
+    *out = (long long)(zz >> 1) ^ -(long long)(zz & 1);
+    return 0;
+}
+
+static int r_f64(Reader *r, double *out) {
+    if (r_need(r, 8) < 0) return -1;
+    unsigned long long u = 0;
+    for (int i = 0; i < 8; i++) u = (u << 8) | r->data[r->pos++];
+    union { double d; unsigned long long u; } x;
+    x.u = u;
+    *out = x.d;
+    return 0;
+}
+
+/* ------------------------------------------------------------------ */
+/* encode                                                              */
+
+static int enc(PyObject *obj, Writer *w);
+
+static int enc_seq_items(PyObject *fast, Writer *w) {
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (enc(PySequence_Fast_GET_ITEM(fast, i), w) < 0) return -1;
+    }
+    return 0;
+}
+
+static int enc_registered(PyObject *obj, Writer *w) {
+    PyObject *type = (PyObject *)Py_TYPE(obj);
+    PyObject *idobj = PyDict_GetItemWithError(g_id_by_type, type);
+    if (!idobj) {
+        if (!PyErr_Occurred())
+            PyErr_Format(g_fallback, "unregistered type %s",
+                         Py_TYPE(obj)->tp_name);
+        return -1;
+    }
+    long long tid = PyLong_AsLongLong(idobj);
+    if (tid < 0 && PyErr_Occurred()) return -1;
+    if (w_varint(w, 16 + tid) < 0) return -1;
+    PyObject *fields = PyDict_GetItemWithError(g_fields_by_id, idobj);
+    if (!fields) {
+        if (PyErr_Occurred()) return -1;
+        PyErr_Format(g_fallback, "no codec meta for id %lld", tid);
+        return -1;
+    }
+    if (fields == Py_None) { /* custom write_object via Python */
+        PyObject *body = PyObject_CallOneArg(g_encode_body, obj);
+        if (!body) return -1;
+        char *p;
+        Py_ssize_t n;
+        if (PyBytes_AsStringAndSize(body, &p, &n) < 0) {
+            Py_DECREF(body);
+            return -1;
+        }
+        int rc = w_raw(w, p, n);
+        Py_DECREF(body);
+        return rc;
+    }
+    Py_ssize_t nf = PyTuple_GET_SIZE(fields);
+    for (Py_ssize_t i = 0; i < nf; i++) {
+        PyObject *val = PyObject_GetAttr(obj, PyTuple_GET_ITEM(fields, i));
+        if (!val) return -1;
+        int rc = enc(val, w);
+        Py_DECREF(val);
+        if (rc < 0) return -1;
+    }
+    return 0;
+}
+
+static int enc(PyObject *obj, Writer *w) {
+    if (obj == Py_None) return w_varint(w, T_NULL);
+    if (obj == Py_True) return w_varint(w, T_TRUE);
+    if (obj == Py_False) return w_varint(w, T_FALSE);
+    if (PyLong_Check(obj)) {
+        int overflow = 0;
+        long long v = PyLong_AsLongLongAndOverflow(obj, &overflow);
+        if (overflow) {
+            PyErr_SetString(g_fallback, "int exceeds 64 bits");
+            return -1;
+        }
+        if (v == -1 && PyErr_Occurred()) return -1;
+        if (w_varint(w, T_INT) < 0) return -1;
+        return w_varint(w, v);
+    }
+    if (PyFloat_Check(obj)) {
+        if (w_varint(w, T_FLOAT) < 0) return -1;
+        return w_f64(w, PyFloat_AS_DOUBLE(obj));
+    }
+    if (PyUnicode_Check(obj)) {
+        Py_ssize_t n;
+        const char *s = PyUnicode_AsUTF8AndSize(obj, &n);
+        if (!s) return -1;
+        if (w_varint(w, T_STR) < 0 || w_varint(w, n) < 0) return -1;
+        return w_raw(w, s, n);
+    }
+    if (PyBytes_Check(obj) || PyByteArray_Check(obj)) {
+        char *p;
+        Py_ssize_t n;
+        if (PyBytes_Check(obj)) {
+            if (PyBytes_AsStringAndSize(obj, &p, &n) < 0) return -1;
+        } else {
+            p = PyByteArray_AS_STRING(obj);
+            n = PyByteArray_GET_SIZE(obj);
+        }
+        if (w_varint(w, T_BYTES) < 0 || w_varint(w, n) < 0) return -1;
+        return w_raw(w, p, n);
+    }
+    if (PyList_Check(obj)) {
+        if (w_varint(w, T_LIST) < 0 ||
+            w_varint(w, PyList_GET_SIZE(obj)) < 0)
+            return -1;
+        return enc_seq_items(obj, w);
+    }
+    if (PyTuple_Check(obj)) {
+        if (w_varint(w, T_TUPLE) < 0 ||
+            w_varint(w, PyTuple_GET_SIZE(obj)) < 0)
+            return -1;
+        return enc_seq_items(obj, w);
+    }
+    if (PyAnySet_Check(obj)) {
+        /* Python sorts each item's FULL encoding for determinism */
+        Py_ssize_t n = PySet_GET_SIZE(obj);
+        if (w_varint(w, T_SET) < 0 || w_varint(w, n) < 0) return -1;
+        PyObject *parts = PyList_New(0);
+        if (!parts) return -1;
+        PyObject *it = PyObject_GetIter(obj), *item;
+        if (!it) { Py_DECREF(parts); return -1; }
+        while ((item = PyIter_Next(it)) != NULL) {
+            Writer iw = {NULL, 0, 0};
+            if (enc(item, &iw) < 0) {
+                Py_DECREF(item); Py_DECREF(it); Py_DECREF(parts);
+                PyMem_Free(iw.buf);
+                return -1;
+            }
+            Py_DECREF(item);
+            PyObject *bs = PyBytes_FromStringAndSize((char *)iw.buf, iw.len);
+            PyMem_Free(iw.buf);
+            if (!bs || PyList_Append(parts, bs) < 0) {
+                Py_XDECREF(bs); Py_DECREF(it); Py_DECREF(parts);
+                return -1;
+            }
+            Py_DECREF(bs);
+        }
+        Py_DECREF(it);
+        if (PyErr_Occurred()) { Py_DECREF(parts); return -1; }
+        if (PyList_Sort(parts) < 0) { Py_DECREF(parts); return -1; }
+        for (Py_ssize_t i = 0; i < PyList_GET_SIZE(parts); i++) {
+            PyObject *bs = PyList_GET_ITEM(parts, i);
+            if (w_raw(w, PyBytes_AS_STRING(bs), PyBytes_GET_SIZE(bs)) < 0) {
+                Py_DECREF(parts);
+                return -1;
+            }
+        }
+        Py_DECREF(parts);
+        return 0;
+    }
+    if (PyDict_Check(obj)) {
+        if (w_varint(w, T_DICT) < 0 ||
+            w_varint(w, PyDict_GET_SIZE(obj)) < 0)
+            return -1;
+        Py_ssize_t pos = 0;
+        PyObject *k, *v;
+        while (PyDict_Next(obj, &pos, &k, &v)) {
+            if (enc(k, w) < 0 || enc(v, w) < 0) return -1;
+        }
+        return 0;
+    }
+    if (PyType_Check(obj)) {
+        PyObject *idobj = PyDict_GetItemWithError(g_id_by_type, obj);
+        if (!idobj) {
+            if (!PyErr_Occurred())
+                PyErr_Format(g_fallback, "unregistered class %s",
+                             ((PyTypeObject *)obj)->tp_name);
+            return -1;
+        }
+        long long tid = PyLong_AsLongLong(idobj);
+        if (tid < 0 && PyErr_Occurred()) return -1;
+        if (w_varint(w, T_CLASS) < 0) return -1;
+        return w_varint(w, tid);
+    }
+    return enc_registered(obj, w);
+}
+
+/* ------------------------------------------------------------------ */
+/* decode                                                              */
+
+static PyObject *dec(Reader *r);
+
+static PyObject *dec_registered(Reader *r, long long tid) {
+    PyObject *idobj = PyLong_FromLongLong(tid);
+    if (!idobj) return NULL;
+    PyObject *cls = PyDict_GetItemWithError(g_type_by_id, idobj);
+    if (!cls) {
+        if (!PyErr_Occurred())
+            PyErr_Format(g_fallback, "unknown serialization id %lld", tid);
+        Py_DECREF(idobj);
+        return NULL;
+    }
+    PyObject *fields = PyDict_GetItemWithError(g_fields_by_id, idobj);
+    Py_DECREF(idobj);
+    if (!fields) {
+        if (!PyErr_Occurred())
+            PyErr_Format(g_fallback, "no codec meta for id %lld", tid);
+        return NULL;
+    }
+    if (fields == Py_None) { /* custom read_object via Python */
+        PyObject *res = PyObject_CallFunction(
+            g_decode_body, "OOn", cls, r->source, r->pos);
+        if (!res) return NULL;
+        PyObject *obj = PyTuple_GetItem(res, 0);
+        PyObject *np = PyTuple_GetItem(res, 1);
+        if (!obj || !np) { Py_DECREF(res); return NULL; }
+        long long newpos = PyLong_AsLongLong(np);
+        if (newpos < 0 && PyErr_Occurred()) { Py_DECREF(res); return NULL; }
+        r->pos = (Py_ssize_t)newpos;
+        Py_INCREF(obj);
+        Py_DECREF(res);
+        return obj;
+    }
+    /* cls.__new__(cls): allocate without running __init__ (the generic
+     * field-list read path, like serializer.py read_object) */
+    PyObject *newf = PyObject_GetAttrString(cls, "__new__");
+    if (!newf) return NULL;
+    PyObject *obj = PyObject_CallOneArg(newf, cls);
+    Py_DECREF(newf);
+    if (!obj) return NULL;
+    Py_ssize_t nf = PyTuple_GET_SIZE(fields);
+    for (Py_ssize_t i = 0; i < nf; i++) {
+        PyObject *val = dec(r);
+        if (!val) { Py_DECREF(obj); return NULL; }
+        int rc = PyObject_SetAttr(obj, PyTuple_GET_ITEM(fields, i), val);
+        Py_DECREF(val);
+        if (rc < 0) { Py_DECREF(obj); return NULL; }
+    }
+    return obj;
+}
+
+static PyObject *dec(Reader *r) {
+    long long tag;
+    if (r_varint(r, &tag) < 0) return NULL;
+    switch (tag) {
+    case T_NULL: Py_RETURN_NONE;
+    case T_TRUE: Py_RETURN_TRUE;
+    case T_FALSE: Py_RETURN_FALSE;
+    case T_INT: {
+        long long v;
+        if (r_varint(r, &v) < 0) return NULL;
+        return PyLong_FromLongLong(v);
+    }
+    case T_FLOAT: {
+        double d;
+        if (r_f64(r, &d) < 0) return NULL;
+        return PyFloat_FromDouble(d);
+    }
+    case T_STR: {
+        long long n;
+        if (r_varint(r, &n) < 0) return NULL;
+        if (n < 0 || r_need(r, (Py_ssize_t)n) < 0) return NULL;
+        PyObject *s = PyUnicode_DecodeUTF8(
+            (const char *)r->data + r->pos, (Py_ssize_t)n, NULL);
+        if (s) r->pos += (Py_ssize_t)n;
+        return s;
+    }
+    case T_BYTES: {
+        long long n;
+        if (r_varint(r, &n) < 0) return NULL;
+        if (n < 0 || r_need(r, (Py_ssize_t)n) < 0) return NULL;
+        PyObject *b = PyBytes_FromStringAndSize(
+            (const char *)r->data + r->pos, (Py_ssize_t)n);
+        if (b) r->pos += (Py_ssize_t)n;
+        return b;
+    }
+    case T_LIST: {
+        long long n;
+        if (r_varint(r, &n) < 0 || n < 0) return NULL;
+        PyObject *lst = PyList_New((Py_ssize_t)n);
+        if (!lst) return NULL;
+        for (Py_ssize_t i = 0; i < (Py_ssize_t)n; i++) {
+            PyObject *item = dec(r);
+            if (!item) { Py_DECREF(lst); return NULL; }
+            PyList_SET_ITEM(lst, i, item);
+        }
+        return lst;
+    }
+    case T_TUPLE: {
+        long long n;
+        if (r_varint(r, &n) < 0 || n < 0) return NULL;
+        PyObject *tup = PyTuple_New((Py_ssize_t)n);
+        if (!tup) return NULL;
+        for (Py_ssize_t i = 0; i < (Py_ssize_t)n; i++) {
+            PyObject *item = dec(r);
+            if (!item) { Py_DECREF(tup); return NULL; }
+            PyTuple_SET_ITEM(tup, i, item);
+        }
+        return tup;
+    }
+    case T_SET: {
+        long long n;
+        if (r_varint(r, &n) < 0 || n < 0) return NULL;
+        PyObject *set = PySet_New(NULL);
+        if (!set) return NULL;
+        for (Py_ssize_t i = 0; i < (Py_ssize_t)n; i++) {
+            PyObject *item = dec(r);
+            if (!item || PySet_Add(set, item) < 0) {
+                Py_XDECREF(item); Py_DECREF(set);
+                return NULL;
+            }
+            Py_DECREF(item);
+        }
+        return set;
+    }
+    case T_DICT: {
+        long long n;
+        if (r_varint(r, &n) < 0 || n < 0) return NULL;
+        PyObject *d = PyDict_New();
+        if (!d) return NULL;
+        for (Py_ssize_t i = 0; i < (Py_ssize_t)n; i++) {
+            PyObject *k = dec(r); /* key first, like the dict comp */
+            if (!k) { Py_DECREF(d); return NULL; }
+            PyObject *v = dec(r);
+            if (!v || PyDict_SetItem(d, k, v) < 0) {
+                Py_DECREF(k); Py_XDECREF(v); Py_DECREF(d);
+                return NULL;
+            }
+            Py_DECREF(k);
+            Py_DECREF(v);
+        }
+        return d;
+    }
+    case T_CLASS: {
+        long long tid;
+        if (r_varint(r, &tid) < 0) return NULL;
+        PyObject *idobj = PyLong_FromLongLong(tid);
+        if (!idobj) return NULL;
+        PyObject *cls = PyDict_GetItemWithError(g_type_by_id, idobj);
+        Py_DECREF(idobj);
+        if (!cls) {
+            if (!PyErr_Occurred())
+                PyErr_Format(g_fallback, "unknown class id %lld", tid);
+            return NULL;
+        }
+        Py_INCREF(cls);
+        return cls;
+    }
+    default:
+        if (tag < 16) {
+            PyErr_Format(g_fallback, "unknown wire tag %lld", tag);
+            return NULL;
+        }
+        return dec_registered(r, tag - 16);
+    }
+}
+
+/* ------------------------------------------------------------------ */
+/* module functions                                                    */
+
+static PyObject *codec_encode(PyObject *self, PyObject *obj) {
+    (void)self;
+    Writer w = {NULL, 0, 0};
+    if (enc(obj, &w) < 0) {
+        PyMem_Free(w.buf);
+        return NULL;
+    }
+    PyObject *out = PyBytes_FromStringAndSize((char *)w.buf, w.len);
+    PyMem_Free(w.buf);
+    return out;
+}
+
+static PyObject *codec_decode(PyObject *self, PyObject *data) {
+    (void)self;
+    if (!PyBytes_Check(data)) {
+        PyErr_SetString(PyExc_TypeError, "decode() needs bytes");
+        return NULL;
+    }
+    Reader r = {(const unsigned char *)PyBytes_AS_STRING(data),
+                PyBytes_GET_SIZE(data), 0, data};
+    PyObject *obj = dec(&r);
+    if (obj && r.pos != r.len) {
+        /* trailing bytes mean a framing mismatch — surface it */
+        Py_DECREF(obj);
+        PyErr_Format(g_fallback, "decode left %zd trailing bytes",
+                     r.len - r.pos);
+        return NULL;
+    }
+    return obj;
+}
+
+static PyObject *codec_configure(PyObject *self, PyObject *args) {
+    (void)self;
+    PyObject *ibt, *tbi, *fbi, *eb, *db;
+    if (!PyArg_ParseTuple(args, "OOOOO", &ibt, &tbi, &fbi, &eb, &db))
+        return NULL;
+    Py_XDECREF(g_id_by_type); Py_INCREF(ibt); g_id_by_type = ibt;
+    Py_XDECREF(g_type_by_id); Py_INCREF(tbi); g_type_by_id = tbi;
+    Py_XDECREF(g_fields_by_id); Py_INCREF(fbi); g_fields_by_id = fbi;
+    Py_XDECREF(g_encode_body); Py_INCREF(eb); g_encode_body = eb;
+    Py_XDECREF(g_decode_body); Py_INCREF(db); g_decode_body = db;
+    Py_RETURN_NONE;
+}
+
+static PyMethodDef codec_methods[] = {
+    {"configure", codec_configure, METH_VARARGS,
+     "configure(id_by_type, type_by_id, fields_by_id, encode_body, "
+     "decode_body) — bind the live registries + fallback hooks."},
+    {"encode", codec_encode, METH_O, "encode(obj) -> bytes"},
+    {"decode", codec_decode, METH_O, "decode(bytes) -> obj"},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef codec_module = {
+    PyModuleDef_HEAD_INIT, "copycat_codec",
+    "Native Catalyst-wire codec (see io/serializer.py for the format).",
+    -1, codec_methods, NULL, NULL, NULL, NULL};
+
+PyMODINIT_FUNC PyInit_copycat_codec(void) {
+    PyObject *m = PyModule_Create(&codec_module);
+    if (!m) return NULL;
+    g_fallback = PyErr_NewException("copycat_codec.Fallback", NULL, NULL);
+    if (!g_fallback || PyModule_AddObject(m, "Fallback", g_fallback) < 0) {
+        Py_XDECREF(g_fallback);
+        Py_DECREF(m);
+        return NULL;
+    }
+    Py_INCREF(g_fallback); /* module owns one ref; we keep the global */
+    return m;
+}

@@ -210,6 +210,28 @@ def test_query_lane_allowed_and_never_escalates_on_monotone_engine():
     assert not any(groups._queues.values())
 
 
+def test_timeout_resyncs_stream_cursor_engine_not_wedged():
+    """A drive that times out mid-stream must leave the engine usable:
+    the device consumed tags the host never saw resolve, so the cursor
+    resyncs from the device ring and the NEXT drive's tags are accepted
+    (round-4 review: the stale cursor wedged every later drive)."""
+    groups = RaftGroups(4, 3, log_slots=32, submit_slots=4, seed=29,
+                        config=Config(monotone_tag_accept=True))
+    groups.wait_for_leaders()
+    driver = BulkDriver(groups)
+    g = np.repeat(np.arange(4), 8)
+    # max_rounds too small to even finish phase 1 + settle + harvest
+    with pytest.raises(TimeoutError):
+        driver.drive(g, ap.OP_LONG_ADD, 1, max_rounds=1)
+    # the engine recovers: a fresh drive completes and its results account
+    # for WHATEVER prefix of the abandoned drive committed (at-most-once
+    # for abandoned ops — each group's counter is monotone and the new
+    # ops' deltas all land exactly once)
+    res = driver.drive(g, ap.OP_LONG_ADD, 1)
+    vals = res.results.reshape(4, 8)
+    assert (np.diff(vals, axis=1) == 1).all()  # FIFO, each delta once
+
+
 def test_deep_drive_session_events_ingested():
     """Lock grants ride the event ring; the deep drive's rare ev path
     must still deliver them to the host buffer."""
