@@ -36,7 +36,7 @@ print(jax.devices(), flush=True)
 def require_devices(env: str = "COPYCAT_DEVICE_TIMEOUT",
                     default_s: float = 120.0,
                     probes_env: str = "COPYCAT_DEVICE_PROBES",
-                    default_probes: int = 3,
+                    default_probes: int = 5,
                     retry_wait_s: float = 60.0) -> None:
     """Fail fast (exit 2) when the accelerator is unreachable — with retries.
 

@@ -17,13 +17,20 @@ import numpy as np
 
 from copycat_tpu.models import BulkDriver, RaftGroups
 from copycat_tpu.ops.apply import OP_LONG_ADD
+from copycat_tpu.ops.consensus import Config
 
 
 def main() -> None:
     groups_n = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
     per_group = int(sys.argv[2]) if len(sys.argv) > 2 else 64
 
-    rg = RaftGroups(groups_n, 3, log_slots=64, submit_slots=16)
+    # monotone_tag_accept = the DEEP pipeline: FIFO + dedup enforced on
+    # device by the tag gate, so the driver dispatches with zero blocking
+    # fetches and harvests one buffer per drive (the tunnel-latency
+    # killer; see PERF.md round 4)
+    rg = RaftGroups(groups_n, 3, log_slots=64, submit_slots=16,
+                    config=Config(monotone_tag_accept=True,
+                                  append_window=16, applies_per_round=16))
     print(f"electing leaders across {groups_n} groups x 3 peers ...")
     rg.wait_for_leaders()
 
