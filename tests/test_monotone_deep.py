@@ -210,6 +210,95 @@ def test_query_lane_allowed_and_never_escalates_on_monotone_engine():
     assert not any(groups._queues.values())
 
 
+def _isolate(groups, g, peer):
+    """Deliver mask cutting ``peer`` off group ``g`` both directions."""
+    G, P = groups.num_groups, groups.num_peers
+    deliver = np.ones((G, P, P), bool)
+    deliver[g, peer, :] = False
+    deliver[g, :, peer] = False
+    return jnp.asarray(deliver)
+
+
+def test_gate_exactly_once_across_leader_change_uncommitted_tail():
+    """The soundness hinge: ops accepted into a leader log that NEVER
+    replicated are lost with that leader; the gate must accept the
+    re-dispatch at the new leader (tags > its ring max) and each op
+    applies EXACTLY once."""
+    groups = RaftGroups(2, 3, log_slots=16, submit_slots=2, seed=31,
+                        config=Config(monotone_tag_accept=True,
+                                      timer_min=2, timer_max=4,
+                                      lease_gated_accept=False))
+    groups.wait_for_leaders()
+    out = _step_raw(groups, groups._empty_submits())
+    lead = int(np.asarray(out.leader)[0])
+    # isolate the leader FIRST, then submit [1,2]: the leader accepts
+    # them (no lease gate) but can never replicate them
+    saved = groups.deliver
+    groups.deliver = _isolate(groups, 0, lead)
+    for _ in range(3):
+        out = _step_raw(groups, _submit_window(groups, 0, [1, 2]))
+        if np.asarray(out.accepted)[0].all():
+            break
+    else:
+        pytest.fail("doomed leader never accepted the window")
+    # let a new leader rise among the connected majority
+    for _ in range(20):
+        out = _step_raw(groups, groups._empty_submits())
+        new_lead = int(np.asarray(out.leader)[0])
+        if new_lead not in (-1, lead):
+            break
+    else:
+        pytest.fail("no new leader elected")
+    # re-dispatch the lost ops at the new leader: ring max is 0 there,
+    # so [1,2] must be accepted again
+    for _ in range(10):
+        out = _step_raw(groups, _submit_window(groups, 0, [1, 2]))
+        if np.asarray(out.accepted)[0].all():
+            break
+    else:
+        pytest.fail("re-dispatch never accepted at the new leader")
+    # heal; old leader rewinds and adopts the new log
+    groups.deliver = saved
+    for _ in range(10):
+        _step_raw(groups, groups._empty_submits())
+    # exactly-once: counter == 2 on the applied state of every live lane
+    val = groups.value(0, peer=int(np.asarray(_step_raw(
+        groups, groups._empty_submits()).leader)[0]))
+    assert val == 2, f"counter {val}: an op applied twice or never"
+
+
+def test_gate_dedups_committed_ops_across_leader_change():
+    """Committed entries survive elections (leader completeness), so a
+    duplicate re-send after failover must be rejected."""
+    groups = RaftGroups(2, 3, log_slots=16, submit_slots=2, seed=37,
+                        config=Config(monotone_tag_accept=True,
+                                      timer_min=2, timer_max=4))
+    groups.wait_for_leaders()
+    for _ in range(10):
+        out = _step_raw(groups, _submit_window(groups, 0, [1, 2]))
+        if np.asarray(out.accepted)[0].all():
+            break
+    for _ in range(4):  # commit + apply on a quorum
+        out = _step_raw(groups, groups._empty_submits())
+    lead = int(np.asarray(out.leader)[0])
+    saved = groups.deliver
+    groups.deliver = _isolate(groups, 0, lead)
+    for _ in range(20):
+        out = _step_raw(groups, groups._empty_submits())
+        if int(np.asarray(out.leader)[0]) not in (-1, lead):
+            break
+    # duplicate re-send at the new leader: its log CONTAINS [1,2]
+    # (committed entries survive) -> ring max 2 -> rejected
+    out = _step_raw(groups, _submit_window(groups, 0, [1, 2]))
+    assert not np.asarray(out.accepted)[0].any()
+    groups.deliver = saved
+    for _ in range(8):
+        _step_raw(groups, groups._empty_submits())
+    val = groups.value(0, peer=int(np.asarray(_step_raw(
+        groups, groups._empty_submits()).leader)[0]))
+    assert val == 2
+
+
 def test_timeout_resyncs_stream_cursor_engine_not_wedged():
     """A drive that times out mid-stream must leave the engine usable:
     the device consumed tags the host never saw resolve, so the cursor
