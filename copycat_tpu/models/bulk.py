@@ -58,6 +58,25 @@ def _scatter(G: int, S: int, gi, slots, vals) -> np.ndarray:
     return arr
 
 
+def _window_rank(mask: np.ndarray, starts: np.ndarray, counts: np.ndarray,
+                 S: int) -> tuple[np.ndarray, np.ndarray]:
+    """First <=S True positions per segment, vectorized.
+
+    ``mask`` lives in group-sorted space with segments described by
+    ``starts``/``counts``; returns ``(positions, slots)`` where each
+    position's slot is its rank among its segment's True entries. The
+    per-pass scheduling core shared by the classic drive and the query
+    drive (FIFO by construction: earlier pending ops always outrank
+    later ones)."""
+    mi = mask.astype(np.int64)
+    excl = np.cumsum(mi) - mi
+    base = np.repeat(excl[starts], counts)
+    rank = excl - base
+    sel = mask & (rank < S)
+    pos = np.flatnonzero(sel)
+    return pos, rank[pos]
+
+
 @lru_cache(maxsize=None)
 def _deep_program(config):
     """Jitted deep_step shared across drivers with the same static Config."""
@@ -161,14 +180,9 @@ class BulkDriver:
 
         def build(r: int):
             """First ≤S unaccepted ops per group, in op order."""
-            mask = ~accepted_ops[order]
-            mi = mask.astype(np.int64)
-            excl = np.cumsum(mi) - mi          # exclusive prefix count
-            base = np.repeat(excl[starts], counts)
-            rank = excl - base                 # unaccepted-rank in group
-            sel = mask & (rank < S)
-            idx = order[sel]
-            slots = rank[sel]
+            pos, slots = _window_rank(~accepted_ops[order], starts,
+                                      counts, S)
+            idx = order[pos]
             sub = rg._empty_submits()
             gi = g_arr[idx]
             sub.opcode[gi, slots] = op_a[idx]
@@ -255,6 +269,91 @@ class BulkDriver:
                           dispatch_round=dispatch_round,
                           resolve_round=resolve_round)
 
+
+    def drive_queries(self, groups, opcode, a=0, b=0, c=0,
+                      consistency: str = "sequential",
+                      max_rounds: int = 200) -> np.ndarray:
+        """Serve one READ per entry of ``groups`` through the query lane
+        (no log append — ops/consensus.query_step) and return results
+        aligned with the input.
+
+        ``consistency``: ``"sequential"``/``"causal"``/``"process"`` read
+        the leader's applied state; ``"atomic"`` additionally gates each
+        slot on the leader LEASE (BOUNDED_LINEARIZABLE — reference
+        Consistency.java:157-176) so the read is linearizable without a
+        quorum round. Unserved slots (leaderless group, fresh leader,
+        applied < commit, cold lease) retry after stepping a settle
+        round. Works on BOTH classic and monotone engines: queries never
+        append, so the tag gate is irrelevant.
+
+        Throughput shape: each pass evaluates up to S reads per group in
+        ONE jitted call over all groups — B reads/group cost ceil(B/S)
+        query calls (plus settle rounds only when slots go unserved).
+        """
+        rg = self._rg
+        from ..ops.apply import QUERY_OPCODES
+
+        g_arr = np.asarray(groups, np.int64).ravel()
+        n = g_arr.size
+        if n == 0:
+            return np.zeros(0, np.int64)
+        bc = lambda x: np.broadcast_to(
+            np.asarray(x, np.int32).ravel(), (n,)).copy()
+        op_a, a_a, b_a, c_a = bc(opcode), bc(a), bc(b), bc(c)
+        bad = set(np.unique(op_a).tolist()) - QUERY_OPCODES
+        if bad:
+            raise ValueError(
+                f"opcodes {sorted(bad)} are not read-only; drive them "
+                "as commands")
+        levels = ("causal", "process", "sequential", "atomic")
+        if consistency not in levels:
+            raise ValueError(f"consistency {consistency!r}: one of {levels}")
+
+        S = rg.submit_slots
+        G = rg.num_groups
+        order = np.argsort(g_arr, kind="stable")
+        g_s = g_arr[order]
+        op_s, a_s, b_s, c_s = (x[order] for x in (op_a, a_a, b_a, c_a))
+        firsts = np.ones(n, bool)
+        firsts[1:] = g_s[1:] != g_s[:-1]
+        starts = np.flatnonzero(firsts)
+        counts = np.diff(np.append(starts, n))
+
+        results = np.zeros(n, np.int64)
+        done = np.zeros(n, bool)
+        want_atomic = consistency == "atomic"
+        rounds = 0
+        while not done.all():
+            if rounds > max_rounds:
+                raise TimeoutError(
+                    f"bulk queries: {int(n - done.sum())} unserved after "
+                    f"{max_rounds} passes")
+            # first <=S unserved reads per group, vectorized ranking
+            pos, slots = _window_rank(~done, starts, counts, S)
+            gi = g_s[pos]
+            sub = rg._empty_submits()
+            sub.opcode[gi, slots] = op_s[pos]
+            sub.a[gi, slots] = a_s[pos]
+            sub.b[gi, slots] = b_s[pos]
+            sub.c[gi, slots] = c_s[pos]
+            sub.valid[gi, slots] = True
+            atomic = np.zeros((G, S), bool)
+            if want_atomic:
+                atomic[gi, slots] = True
+            res, served = rg._run_query(sub, atomic)
+            hit = served[gi, slots]
+            results[pos[hit]] = res[gi[hit], slots[hit]]
+            done[pos[hit]] = True
+            if not hit.all():
+                # only pay a consensus step when a slot went UNSERVED
+                # (cold lease / fresh leader / apply lag) — fully-served
+                # passes chain query calls back to back
+                rg.step_round()
+            rounds += 1
+
+        out = np.zeros(n, np.int64)
+        out[order] = results
+        return out
 
     def _resync_stream_count(self) -> None:
         """Set each group's stream cursor to the max live-ring tag on the

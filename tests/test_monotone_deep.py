@@ -321,6 +321,40 @@ def test_timeout_resyncs_stream_cursor_engine_not_wedged():
     assert (np.diff(vals, axis=1) == 1).all()  # FIFO, each delta once
 
 
+def test_bulk_query_drive_all_levels():
+    """Client-visible bulk READS through the no-append query lane: each
+    level serves the applied value; ATOMIC additionally rides the leader
+    lease (linearizable with zero log entries)."""
+    groups = RaftGroups(8, 3, log_slots=32, submit_slots=4, seed=41,
+                        config=Config(monotone_tag_accept=True))
+    groups.wait_for_leaders()
+    driver = BulkDriver(groups)
+    g = np.repeat(np.arange(8), 5)
+    driver.drive(g, ap.OP_LONG_ADD, 1)   # counters now 5 everywhere
+    reads = np.repeat(np.arange(8), 7)
+    for level in ("sequential", "atomic", "causal", "process"):
+        got = driver.drive_queries(reads, ap.OP_VALUE_GET,
+                                   consistency=level)
+        assert (got == 5).all(), (level, got)
+
+
+def test_bulk_query_drive_map_and_errors():
+    groups = RaftGroups(4, 3, log_slots=32, submit_slots=4, seed=43,
+                        config=Config(monotone_tag_accept=True))
+    groups.wait_for_leaders()
+    driver = BulkDriver(groups)
+    n = 4 * 6
+    g = np.repeat(np.arange(4), 6)
+    driver.drive(g, ap.OP_MAP_PUT, np.tile(np.arange(6), 4),
+                 100 + np.arange(n))
+    got = driver.drive_queries(g, ap.OP_MAP_GET, np.tile(np.arange(6), 4))
+    assert (got == 100 + np.arange(n)).all()
+    with pytest.raises(ValueError):
+        driver.drive_queries(g, ap.OP_LONG_ADD, 1)  # not read-only
+    with pytest.raises(ValueError):
+        driver.drive_queries(g, ap.OP_MAP_GET, 0, consistency="nope")
+
+
 def test_deep_drive_session_events_ingested():
     """Lock grants ride the event ring; the deep drive's rare ev path
     must still deliver them to the host buffer."""
