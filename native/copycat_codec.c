@@ -149,17 +149,22 @@ static int r_f64(Reader *r, double *out) {
 /* ------------------------------------------------------------------ */
 /* encode                                                              */
 
-static int enc(PyObject *obj, Writer *w);
+static int enc(PyObject *obj, Writer *w, int depth);
 
-static int enc_seq_items(PyObject *fast, Writer *w) {
+/* matches Python's recursion limit semantics: deeper graphs fall
+ * back to the pure-Python codec, which raises RecursionError
+ * cleanly instead of overflowing the C stack (fuzz finding) */
+#define MAX_DEPTH 1000
+
+static int enc_seq_items(PyObject *fast, Writer *w, int depth) {
     Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
     for (Py_ssize_t i = 0; i < n; i++) {
-        if (enc(PySequence_Fast_GET_ITEM(fast, i), w) < 0) return -1;
+        if (enc(PySequence_Fast_GET_ITEM(fast, i), w, depth) < 0) return -1;
     }
     return 0;
 }
 
-static int enc_registered(PyObject *obj, Writer *w) {
+static int enc_registered(PyObject *obj, Writer *w, int depth) {
     PyObject *type = (PyObject *)Py_TYPE(obj);
     PyObject *idobj = PyDict_GetItemWithError(g_id_by_type, type);
     if (!idobj) {
@@ -194,14 +199,18 @@ static int enc_registered(PyObject *obj, Writer *w) {
     for (Py_ssize_t i = 0; i < nf; i++) {
         PyObject *val = PyObject_GetAttr(obj, PyTuple_GET_ITEM(fields, i));
         if (!val) return -1;
-        int rc = enc(val, w);
+        int rc = enc(val, w, depth);
         Py_DECREF(val);
         if (rc < 0) return -1;
     }
     return 0;
 }
 
-static int enc(PyObject *obj, Writer *w) {
+static int enc(PyObject *obj, Writer *w, int depth) {
+    if (++depth > MAX_DEPTH) {
+        PyErr_SetString(g_fallback, "graph too deep for the C walker");
+        return -1;
+    }
     if (obj == Py_None) return w_varint(w, T_NULL);
     if (obj == Py_True) return w_varint(w, T_TRUE);
     if (obj == Py_False) return w_varint(w, T_FALSE);
@@ -243,13 +252,13 @@ static int enc(PyObject *obj, Writer *w) {
         if (w_varint(w, T_LIST) < 0 ||
             w_varint(w, PyList_GET_SIZE(obj)) < 0)
             return -1;
-        return enc_seq_items(obj, w);
+        return enc_seq_items(obj, w, depth);
     }
     if (PyTuple_Check(obj)) {
         if (w_varint(w, T_TUPLE) < 0 ||
             w_varint(w, PyTuple_GET_SIZE(obj)) < 0)
             return -1;
-        return enc_seq_items(obj, w);
+        return enc_seq_items(obj, w, depth);
     }
     if (PyAnySet_Check(obj)) {
         /* Python sorts each item's FULL encoding for determinism */
@@ -261,7 +270,7 @@ static int enc(PyObject *obj, Writer *w) {
         if (!it) { Py_DECREF(parts); return -1; }
         while ((item = PyIter_Next(it)) != NULL) {
             Writer iw = {NULL, 0, 0};
-            if (enc(item, &iw) < 0) {
+            if (enc(item, &iw, depth) < 0) {
                 Py_DECREF(item); Py_DECREF(it); Py_DECREF(parts);
                 PyMem_Free(iw.buf);
                 return -1;
@@ -295,7 +304,7 @@ static int enc(PyObject *obj, Writer *w) {
         Py_ssize_t pos = 0;
         PyObject *k, *v;
         while (PyDict_Next(obj, &pos, &k, &v)) {
-            if (enc(k, w) < 0 || enc(v, w) < 0) return -1;
+            if (enc(k, w, depth) < 0 || enc(v, w, depth) < 0) return -1;
         }
         return 0;
     }
@@ -312,15 +321,15 @@ static int enc(PyObject *obj, Writer *w) {
         if (w_varint(w, T_CLASS) < 0) return -1;
         return w_varint(w, tid);
     }
-    return enc_registered(obj, w);
+    return enc_registered(obj, w, depth);
 }
 
 /* ------------------------------------------------------------------ */
 /* decode                                                              */
 
-static PyObject *dec(Reader *r);
+static PyObject *dec(Reader *r, int depth);
 
-static PyObject *dec_registered(Reader *r, long long tid) {
+static PyObject *dec_registered(Reader *r, long long tid, int depth) {
     PyObject *idobj = PyLong_FromLongLong(tid);
     if (!idobj) return NULL;
     PyObject *cls = PyDict_GetItemWithError(g_type_by_id, idobj);
@@ -360,7 +369,7 @@ static PyObject *dec_registered(Reader *r, long long tid) {
     if (!obj) return NULL;
     Py_ssize_t nf = PyTuple_GET_SIZE(fields);
     for (Py_ssize_t i = 0; i < nf; i++) {
-        PyObject *val = dec(r);
+        PyObject *val = dec(r, depth);
         if (!val) { Py_DECREF(obj); return NULL; }
         int rc = PyObject_SetAttr(obj, PyTuple_GET_ITEM(fields, i), val);
         Py_DECREF(val);
@@ -369,7 +378,11 @@ static PyObject *dec_registered(Reader *r, long long tid) {
     return obj;
 }
 
-static PyObject *dec(Reader *r) {
+static PyObject *dec(Reader *r, int depth) {
+    if (++depth > MAX_DEPTH) {
+        PyErr_SetString(g_fallback, "wire graph too deep for the C walker");
+        return NULL;
+    }
     long long tag;
     if (r_varint(r, &tag) < 0) return NULL;
     switch (tag) {
@@ -410,7 +423,7 @@ static PyObject *dec(Reader *r) {
         PyObject *lst = PyList_New((Py_ssize_t)n);
         if (!lst) return NULL;
         for (Py_ssize_t i = 0; i < (Py_ssize_t)n; i++) {
-            PyObject *item = dec(r);
+            PyObject *item = dec(r, depth);
             if (!item) { Py_DECREF(lst); return NULL; }
             PyList_SET_ITEM(lst, i, item);
         }
@@ -422,7 +435,7 @@ static PyObject *dec(Reader *r) {
         PyObject *tup = PyTuple_New((Py_ssize_t)n);
         if (!tup) return NULL;
         for (Py_ssize_t i = 0; i < (Py_ssize_t)n; i++) {
-            PyObject *item = dec(r);
+            PyObject *item = dec(r, depth);
             if (!item) { Py_DECREF(tup); return NULL; }
             PyTuple_SET_ITEM(tup, i, item);
         }
@@ -434,7 +447,7 @@ static PyObject *dec(Reader *r) {
         PyObject *set = PySet_New(NULL);
         if (!set) return NULL;
         for (Py_ssize_t i = 0; i < (Py_ssize_t)n; i++) {
-            PyObject *item = dec(r);
+            PyObject *item = dec(r, depth);
             if (!item || PySet_Add(set, item) < 0) {
                 Py_XDECREF(item); Py_DECREF(set);
                 return NULL;
@@ -449,9 +462,9 @@ static PyObject *dec(Reader *r) {
         PyObject *d = PyDict_New();
         if (!d) return NULL;
         for (Py_ssize_t i = 0; i < (Py_ssize_t)n; i++) {
-            PyObject *k = dec(r); /* key first, like the dict comp */
+            PyObject *k = dec(r, depth); /* key first, like the dict comp */
             if (!k) { Py_DECREF(d); return NULL; }
-            PyObject *v = dec(r);
+            PyObject *v = dec(r, depth);
             if (!v || PyDict_SetItem(d, k, v) < 0) {
                 Py_DECREF(k); Py_XDECREF(v); Py_DECREF(d);
                 return NULL;
@@ -481,7 +494,7 @@ static PyObject *dec(Reader *r) {
             PyErr_Format(g_fallback, "unknown wire tag %lld", tag);
             return NULL;
         }
-        return dec_registered(r, tag - 16);
+        return dec_registered(r, tag - 16, depth);
     }
 }
 
@@ -491,7 +504,7 @@ static PyObject *dec(Reader *r) {
 static PyObject *codec_encode(PyObject *self, PyObject *obj) {
     (void)self;
     Writer w = {NULL, 0, 0};
-    if (enc(obj, &w) < 0) {
+    if (enc(obj, &w, 0) < 0) {
         PyMem_Free(w.buf);
         return NULL;
     }
@@ -508,7 +521,7 @@ static PyObject *codec_decode(PyObject *self, PyObject *data) {
     }
     Reader r = {(const unsigned char *)PyBytes_AS_STRING(data),
                 PyBytes_GET_SIZE(data), 0, data};
-    PyObject *obj = dec(&r);
+    PyObject *obj = dec(&r, 0);
     if (obj && r.pos != r.len) {
         /* trailing bytes mean a framing mismatch — surface it */
         Py_DECREF(obj);
