@@ -25,6 +25,19 @@ from .instance import InstanceClient
 from .operations import CreateResource, GetResource, ResourceExists
 from .state import ResourceManager
 
+# Register the built-in resource library with the serializer. The wire
+# protocol carries class REFERENCES by registry id (the documented
+# deviation from the reference's Class.forName — serializer.py), so a
+# server must know the whole catalog before the first client names a
+# resource class it never imported itself. Single-process tests import
+# everything anyway; a standalone `copycat-server` would otherwise fail
+# to decode GetResource("x", DistributedAtomicValue) from a remote
+# client ("unknown class id" — found driving the packaged server +
+# client examples cross-process).
+from .. import atomic as _atomic  # noqa: F401,E402
+from .. import collections as _collections  # noqa: F401,E402
+from .. import coordination as _coordination  # noqa: F401,E402
+
 R = TypeVar("R", bound=Resource)
 
 
