@@ -78,9 +78,14 @@ def _window_rank(mask: np.ndarray, starts: np.ndarray, counts: np.ndarray,
 
 
 @lru_cache(maxsize=None)
-def _deep_program(config):
-    """Jitted deep_step shared across drivers with the same static Config."""
-    return jax.jit(partial(deep_step, config=config))
+def _deep_program(config, onehot: bool = False):
+    """Jitted deep_step shared across drivers with the same static Config.
+
+    ``onehot`` selects the accumulator formulation: sharded engines use
+    the one-hot select-reduce (shard-local by construction — the .at[]
+    scatter compiled to all-gathers of the [G,B] buffers on a mesh);
+    single-device engines keep the O(G*A) scatter."""
+    return jax.jit(partial(deep_step, config=config, onehot=onehot))
 
 
 class BulkResult:
@@ -415,10 +420,6 @@ class BulkDriver:
         safety is the gate's and holds under any fault.
         """
         rg = self._rg
-        if rg.mesh is not None:
-            raise NotImplementedError(
-                "deep drive targets single-device engines; sharded "
-                "engines use the classic bulk/queue-managed paths")
         S = rg.submit_slots
         G = rg.num_groups
         n = g_arr.size
@@ -458,9 +459,22 @@ class BulkDriver:
         resbuf = jnp.zeros((G, Bpad), jnp.int32)
         valbuf = jnp.zeros((G, Bpad), bool)
         rndbuf = jnp.full((G, Bpad), np.int32(2**30), jnp.int32)
-        evflag = jnp.zeros((), bool)
+        evflag = jnp.zeros(G, bool)  # per-group: no cross-shard reduce
         base_dev = jax.device_put(rg._stream_count.astype(np.int32))
-        _deep = _deep_program(rg.config)
+        if rg.mesh is not None:
+            # sharded engines: the accumulators live group-sharded like
+            # the state, so the scatter in deep_step stays local to each
+            # shard (placement-only, same rule as parallel/mesh.py)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            g_ax = "groups" if "groups" in rg.mesh.axis_names else None
+            sh2 = NamedSharding(rg.mesh, P(g_ax, None))
+            sh1 = NamedSharding(rg.mesh, P(g_ax))
+            resbuf = jax.device_put(resbuf, sh2)
+            valbuf = jax.device_put(valbuf, sh2)
+            rndbuf = jax.device_put(rndbuf, sh2)
+            evflag = jax.device_put(evflag, sh1)
+            base_dev = jax.device_put(base_dev, sh1)
+        _deep = _deep_program(rg.config, onehot=rg.mesh is not None)
 
         # burst-uniform payload leaves travel as SCALARS (zero H2D bytes);
         # per-op payloads fall back to full [G,S] arrays
@@ -505,12 +519,14 @@ class BulkDriver:
             resolved[:] = val_np[seg_groups][colm]
             results[:] = res_np[seg_groups][colm]
             resolve_round[:] = rnd_np[seg_groups][colm]
-            if ev:
+            if ev.any():
                 # rare path (session-event ops in the burst): fetch the
                 # stashed per-round event leaves and ingest with seq dedup
                 for leaves in jax.device_get(ev_stash):
                     rg._ingest_events(_EventView(*leaves))
-                evflag = jnp.zeros((), bool)
+                evflag = jnp.zeros(G, bool)
+                if rg.mesh is not None:
+                    evflag = jax.device_put(evflag, sh1)
             ev_stash.clear()
 
         # phase 1: blind pipelined dispatch — NO device fetch at all. The

@@ -996,7 +996,7 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
 def deep_step(state: RaftState, resbuf: jnp.ndarray, valbuf: jnp.ndarray,
               rndbuf: jnp.ndarray, evflag: jnp.ndarray, base: jnp.ndarray,
               rnd: jnp.ndarray, submits: Submits, deliver: jnp.ndarray,
-              key: jax.Array, config: Config
+              key: jax.Array, config: Config, onehot: bool = False
               ) -> tuple[RaftState, jnp.ndarray, jnp.ndarray, jnp.ndarray,
                          jnp.ndarray, StepOutputs]:
     """One consensus round + ON-DEVICE result accumulation (deep bulk plane).
@@ -1022,12 +1022,38 @@ def deep_step(state: RaftState, resbuf: jnp.ndarray, valbuf: jnp.ndarray,
     G = out.out_tag.shape[0]
     B = resbuf.shape[1]
     k = out.out_tag - 1 - base[:, None]
-    k = jnp.where(out.out_valid & (k >= 0) & (k < B), k, B)  # B = drop
-    g_ids = jnp.arange(G, dtype=jnp.int32)[:, None]
-    resbuf = resbuf.at[g_ids, k].set(out.out_result, mode="drop")
-    rnd_full = jnp.broadcast_to(jnp.asarray(rnd, jnp.int32),
-                                out.out_tag.shape)
-    rndbuf = rndbuf.at[g_ids, k].min(rnd_full, mode="drop")
-    valbuf = valbuf.at[g_ids, k].set(True, mode="drop")
-    evflag = evflag | out.ev_valid.any()
+    ok = out.out_valid & (k >= 0) & (k < B)
+    rnd_i = jnp.asarray(rnd, jnp.int32)
+    if onehot:
+        # One-hot select-reduce: ranks are distinct within a group-round,
+        # so a masked sum over the A axis writes every hit in one fused
+        # pass — and, unlike scatter, it stays SHARD-LOCAL on a
+        # group-sharded mesh (the round-4 collective census caught the
+        # scatter form compiling to all-gathers of the [G,B] buffers).
+        # Cost is O(G*A*B) per round, so the unsharded path below keeps
+        # the O(G*A) scatter instead.
+        hit = jnp.where(ok, k, -1)[:, :, None] \
+            == jnp.arange(B, dtype=jnp.int32)[None, None, :]   # [G,A,B]
+        any_hit = hit.any(axis=1)                               # [G,B]
+        resbuf = jnp.where(
+            any_hit,
+            jnp.where(hit, out.out_result[:, :, None], 0).sum(axis=1),
+            resbuf)
+        rndbuf = jnp.where(
+            any_hit,
+            jnp.minimum(rndbuf,
+                        jnp.where(hit, rnd_i, jnp.int32(2**30)).min(axis=1)),
+            rndbuf)
+        valbuf = valbuf | any_hit
+    else:
+        kk = jnp.where(ok, k, B)  # B = drop sentinel (out of range)
+        g_ids = jnp.arange(G, dtype=jnp.int32)[:, None]
+        resbuf = resbuf.at[g_ids, kk].set(out.out_result, mode="drop")
+        rndbuf = rndbuf.at[g_ids, kk].min(
+            jnp.broadcast_to(rnd_i, kk.shape), mode="drop")
+        valbuf = valbuf.at[g_ids, kk].set(True, mode="drop")
+    # per-GROUP event flag (host ors it after the fetch): a scalar
+    # .any() here would be the one cross-shard all-reduce in the whole
+    # program on a group-sharded mesh (census-verified)
+    evflag = evflag | out.ev_valid.any(axis=1)
     return state, resbuf, valbuf, rndbuf, evflag, out

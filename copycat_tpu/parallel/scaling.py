@@ -51,6 +51,14 @@ COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
 CENSUS_GROUPS = 256
 
 
+def _census_text(txt: str) -> dict:
+    """Tally cross-device collective ops in compiled-module text."""
+    import re
+
+    return {op: n for op in COLLECTIVE_OPS
+            if (n := len(re.findall(rf"\b{op}\b", txt)))}
+
+
 def _collective_census(n_devices: int, devices) -> dict:
     """Count cross-device collective ops in the compiled module — the
     direct witness for (non-)resharding: a purely group-sharded step is
@@ -74,9 +82,82 @@ def _collective_census(n_devices: int, devices) -> dict:
     state = shard_state(state, mesh)
     submits, deliver = shard_step_inputs(submits, deliver, mesh)
     fn = jax.jit(partial(step, config=config))
-    txt = fn.lower(state, submits, deliver, key).compile().as_text()
-    return {op: n for op in COLLECTIVE_OPS
-            if (n := len(re.findall(rf"\b{op}\b", txt)))}
+    return _census_text(
+        fn.lower(state, submits, deliver, key).compile().as_text())
+
+
+def _measure_bulk(n_devices: int, devices) -> dict:
+    """Client-visible deep-drive throughput on the sharded mesh (round-4
+    addition): the FULL bulk plane — blind pipelined dispatch, on-device
+    [G,B] accumulators, one harvest — runs over group-sharded engines,
+    so the client data path scales with devices, not just the raw step.
+    Also censuses the deep_step module for cross-device collectives."""
+    from jax.sharding import Mesh
+
+    from ..models.bulk import BulkDriver
+    from ..models.raft_groups import RaftGroups
+    from ..ops import apply as ap
+    from ..ops.consensus import Config
+
+    mesh = Mesh(np.asarray(devices[:n_devices]), ("groups",))
+    config = Config(append_window=8, applies_per_round=8,
+                    monotone_tag_accept=True)
+    rg = RaftGroups(GROUPS, PEERS, log_slots=32, submit_slots=8,
+                    mesh=mesh, config=config)
+    rg.wait_for_leaders()
+    drv = BulkDriver(rg)
+    g = np.repeat(np.arange(GROUPS), 32)
+    t0 = time.perf_counter()
+    drv.drive(g, ap.OP_LONG_ADD, 1)  # warm (compile + first transfers)
+    warm_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    res = drv.drive(g, ap.OP_LONG_ADD, 1)
+    dt = time.perf_counter() - t0
+
+    collectives = _deep_census(n_devices, devices, config)
+    return {"devices": n_devices,
+            "client_visible_ops_per_sec": round(g.size / dt),
+            "drive_rounds": res.rounds,
+            "warmup_s": round(warm_s, 1),
+            "collectives": collectives}
+
+
+def _deep_census(n_devices: int, devices, config) -> dict:
+    from functools import partial
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ..ops.consensus import (
+        Submits, deep_step, full_delivery, init_state)
+    from ..parallel.mesh import shard_state
+
+    mesh = Mesh(np.asarray(devices[:n_devices]), ("groups",))
+    key = jax.random.PRNGKey(0)
+    key, init_key = jax.random.split(key)
+    state = shard_state(
+        init_state(CENSUS_GROUPS, PEERS, 32, init_key, config), mesh)
+    sh2 = NamedSharding(mesh, P("groups", None))
+    sh1 = NamedSharding(mesh, P("groups"))
+    resbuf = jax.device_put(jnp.zeros((CENSUS_GROUPS, 32), jnp.int32), sh2)
+    valbuf = jax.device_put(jnp.zeros((CENSUS_GROUPS, 32), bool), sh2)
+    rndbuf = jax.device_put(
+        jnp.full((CENSUS_GROUPS, 32), np.int32(2**30), jnp.int32), sh2)
+    # evflag matches production exactly: a [G] group-sharded vector
+    # (a replicated scalar here would census a DIFFERENT program)
+    evflag = jax.device_put(jnp.zeros(CENSUS_GROUPS, bool), sh1)
+    base = jax.device_put(jnp.zeros(CENSUS_GROUPS, jnp.int32), sh1)
+    sub = Submits(opcode=np.int32(5), a=np.int32(1), b=np.int32(0),
+                  c=np.int32(0),
+                  tag=np.zeros((CENSUS_GROUPS, 1), np.int32),
+                  valid=np.zeros((CENSUS_GROUPS, 8), bool))
+    deliver = jax.device_put(
+        full_delivery(CENSUS_GROUPS, PEERS),
+        NamedSharding(mesh, P("groups", None, None)))
+    fn = jax.jit(partial(deep_step, config=config, onehot=True))
+    return _census_text(
+        fn.lower(state, resbuf, valbuf, rndbuf, evflag, base,
+                 np.int32(0), sub, deliver, key).compile().as_text())
 
 
 def _measure(n_devices: int, devices) -> dict:
@@ -131,10 +212,13 @@ def main() -> None:
     for row in rows:
         row["vs_1dev"] = round(row["ms_per_round"] / base, 2)
     no_collectives = all(not row["collectives"] for row in rows)
+    bulk_rows = [_measure_bulk(n, devices) for n in (1, 2, 4, 8)]
+    bulk_no_coll = all(not row["collectives"] for row in bulk_rows)
     result = {"groups": GROUPS, "peers": PEERS, "rounds": ROUNDS,
               "mesh_axis": "groups", "host_cores": host_cores,
               "no_cross_device_collectives": no_collectives,
-              "table": rows}
+              "bulk_no_cross_device_collectives": bulk_no_coll,
+              "table": rows, "bulk_table": bulk_rows}
 
     lines = [
         "# MULTICHIP_SCALING — sharded step over the virtual mesh",
@@ -181,6 +265,29 @@ def main() -> None:
         "cheap reductions); `__graft_entry__.dryrun_multichip` separately",
         "proves the 2D ('groups','peers') sharding compiles and elects",
         "across the mesh every round.",
+        "",
+        "## The CLIENT data path over the sharded mesh (round 4)",
+        "",
+        "The deep bulk pipeline (`models/bulk.py` — device-enforced FIFO,",
+        "on-device [G,B] result accumulators, one harvest per drive) runs",
+        "unchanged over group-sharded engines: the accumulators shard with",
+        "the state, the scatter stays shard-local, and the `deep_step`",
+        "compiled module is censused for collectives the same way:",
+        "",
+        f"- deep_step cross-device collectives at 1/2/4/8 devices: "
+        + ("**none** ✓" if bulk_no_coll else "**FOUND** ✗ (see JSON)"),
+        "",
+        "| devices | client-visible ops/sec | drive rounds | collectives |",
+        "|---|---|---|---|",
+    ] + [
+        f"| {row['devices']} | {row['client_visible_ops_per_sec']:,} "
+        f"| {row['drive_rounds']} | {row['collectives'] or 'none'} |"
+        for row in bulk_rows
+    ] + [
+        "",
+        "(Same oversubscription caveat: virtual devices share this host's",
+        "core, so ops/sec across device counts measures scheduler overhead",
+        "only; zero collectives is the portable witness.)",
         "",
     ]
     with open("MULTICHIP_SCALING.md", "w") as f:

@@ -34,10 +34,14 @@ PORT = 19341  # fixed high port; TIME_WAIT is fine (fresh listen each run)
 async def test_packaged_server_serves_remote_client():
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=os.path.dirname(os.path.dirname(__file__)))
+    # log to a FILE, not a pipe: an undrained pipe fills at ~64KB and
+    # blocks the server mid-run (review finding)
+    import tempfile
+    logf = tempfile.NamedTemporaryFile("w+b", suffix=".log", delete=False)
     proc = subprocess.Popen(
         [sys.executable, "-c",
          f"from copycat_tpu.cli import server; server(['127.0.0.1:{PORT}'])"],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        env=env, stdout=logf, stderr=subprocess.STDOUT)
     try:
         client = (AtomixClient.builder([Address("127.0.0.1", PORT)])
                   .with_transport(TcpTransport()).build())
@@ -48,7 +52,8 @@ async def test_packaged_server_serves_remote_client():
                 break
             except Exception:
                 if proc.poll() is not None:
-                    out = proc.stdout.read().decode(errors="replace")
+                    logf.seek(0)
+                    out = logf.read().decode(errors="replace")
                     pytest.fail(f"server died rc={proc.returncode}: "
                                 f"{out[-800:]}")
                 await asyncio.sleep(2)
