@@ -618,6 +618,7 @@ def run_election() -> dict:
     log(f"bench[election]: warmup saw {int(n)} leader changes")
 
     best = 0.0
+    reps = []
     for rep in range(REPEATS):
         with xla_trace(PROFILE_DIR if rep == 0 else None):
             t0 = time.perf_counter()
@@ -626,6 +627,7 @@ def run_election() -> dict:
             dt = time.perf_counter() - t0
         rate = n / dt
         best = max(best, rate)
+        reps.append(rate)
         log(f"bench[election]: rep {rep}: {n} elections in {dt:.3f}s "
             f"-> {rate:,.0f} elections/sec")
 
@@ -634,6 +636,7 @@ def run_election() -> dict:
         "value": round(best, 1),
         "unit": "elections/sec",
         "vs_baseline": round(best / NORTH_STAR_OPS, 4),
+        **spread(reps),
     }
 
 
@@ -692,6 +695,7 @@ def run_map_read() -> dict:
     log(f"bench[map_read]: warmup completed {int(n)} ops")
 
     best = 0.0
+    reps = []
     for rep in range(REPEATS):
         with xla_trace(PROFILE_DIR if rep == 0 else None):
             t0 = time.perf_counter()
@@ -700,6 +704,7 @@ def run_map_read() -> dict:
             dt = time.perf_counter() - t0
         ops = n / dt
         best = max(best, ops)
+        reps.append(ops)
         log(f"bench[map_read]: rep {rep}: {n} ops in {dt:.3f}s "
             f"-> {ops:,.0f} ops/sec ({dt / ROUNDS * 1e3:.2f} ms/round)")
 
@@ -709,6 +714,7 @@ def run_map_read() -> dict:
         "value": round(best, 1),
         "unit": "ops/sec",
         "vs_baseline": round(best / NORTH_STAR_OPS, 4),
+        **spread(reps),
     }
 
 

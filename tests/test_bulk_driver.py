@@ -79,3 +79,16 @@ def test_bulk_latency_percentiles_shape(rg):
     res = driver.drive(np.arange(8), ap.OP_LONG_ADD, 1)
     pct = res.latency_percentiles_ms()
     assert set(pct) == {"p50", "p99"} and pct["p99"] >= pct["p50"] > 0
+
+
+def test_bulk_query_drive_on_classic_engine(rg):
+    """drive_queries works on NON-monotone engines too — queries never
+    append, so the tag gate is irrelevant (docstring contract)."""
+    driver = BulkDriver(rg)
+    driver.drive(np.arange(8), ap.OP_LONG_ADD, 5)
+    got = driver.drive_queries(np.repeat(np.arange(8), 3), ap.OP_VALUE_GET,
+                               consistency="sequential")
+    # every group's counter is at least 5 (other tests in this module
+    # share the engine); reads must be served and consistent per group
+    assert (got.reshape(8, 3) == got.reshape(8, 3)[:, :1]).all()
+    assert (got >= 5).all()
