@@ -58,6 +58,24 @@ def _scatter(G: int, S: int, gi, slots, vals) -> np.ndarray:
     return arr
 
 
+def stream_count_from_state(state) -> np.ndarray:
+    """[G] max live-ring stream tag per group, from the most-advanced
+    lane's log — the device-authoritative value of the monotone stream
+    cursor (``RaftGroups._stream_count``). Used to resync after an
+    abandoned drive and to rebuild the cursor on checkpoint restore
+    (election no-ops carry tag 0 and never inflate it)."""
+    log_tag, last = (np.asarray(x) for x in jax.device_get(
+        (state.log_tag, state.last_index)))
+    G, _, L = log_tag.shape
+    lane = last.argmax(axis=1)                       # [G]
+    lt = log_tag[np.arange(G), lane]                 # [G,L]
+    ll = last[np.arange(G), lane]                    # [G]
+    j = np.arange(L)[None, :]
+    idx = ll[:, None] - ((ll[:, None] - (j + 1)) % L)
+    in_log = (idx >= 1) & (idx <= ll[:, None])
+    return np.where(in_log, lt, 0).max(axis=1).astype(np.int64)
+
+
 def _window_rank(mask: np.ndarray, starts: np.ndarray, counts: np.ndarray,
                  S: int) -> tuple[np.ndarray, np.ndarray]:
     """First <=S True positions per segment, vectorized.
@@ -380,19 +398,8 @@ class BulkDriver:
         Exact in the deep plane's fault-free world; an error path only
         (one [G,P,L] fetch)."""
         rg = self._rg
-        import jax as _jax
-
-        log_tag, last = (np.asarray(x) for x in _jax.device_get(
-            (rg.state.log_tag, rg.state.last_index)))
-        G, P, L = log_tag.shape
-        lane = last.argmax(axis=1)                       # [G]
-        lt = log_tag[np.arange(G), lane]                 # [G,L]
-        ll = last[np.arange(G), lane]                    # [G]
-        j = np.arange(L)[None, :]
-        idx = ll[:, None] - ((ll[:, None] - (j + 1)) % L)
-        in_log = (idx >= 1) & (idx <= ll[:, None])
-        ring_max = np.where(in_log, lt, 0).max(axis=1)
-        rg._stream_count = np.maximum(rg._stream_count, ring_max)
+        rg._stream_count = np.maximum(rg._stream_count,
+                                      stream_count_from_state(rg.state))
 
     def _drive_deep(self, g_arr, op_a, a_a, b_a, c_a,
                     max_rounds: int, t0: float) -> BulkResult:

@@ -118,4 +118,10 @@ def load(path: str | pathlib.Path, mesh=None):
                      for g, evs in meta.get("events", {}).items()}
         import jax.numpy as jnp
         rg._key = jnp.asarray(np.asarray(meta["key"], np.uint32))
+        if config.monotone_tag_accept:
+            # the monotone stream cursor is DERIVED, not stored: the
+            # restored log ring is authoritative (works for snapshots
+            # taken before the cursor existed)
+            from .bulk import stream_count_from_state
+            rg._stream_count = stream_count_from_state(rg.state)
     return rg

@@ -372,3 +372,26 @@ def test_deep_drive_session_events_ingested():
     assert res.results.size == 3
     assert any(code == ap.EV_LOCK_GRANT and target == 2
                for _, code, target, _ in groups.events.get(0, []))
+
+
+def test_checkpoint_restore_rebuilds_stream_cursor(tmp_path):
+    """Restoring a monotone engine must rebuild _stream_count from the
+    log ring, or the next drive's tags collide with consumed ones and
+    the gate rejects them forever (the cursor is host-side state the
+    snapshot does not carry)."""
+    from copycat_tpu.models import checkpoint
+
+    groups = RaftGroups(6, 3, log_slots=32, submit_slots=4, seed=61,
+                        config=Config(monotone_tag_accept=True))
+    groups.wait_for_leaders()
+    driver = BulkDriver(groups)
+    g = np.repeat(np.arange(6), 9)
+    driver.drive(g, ap.OP_LONG_ADD, 1)
+
+    path = tmp_path / "snap.npz"
+    checkpoint.save(groups, path)
+    restored = checkpoint.load(path)
+    assert (restored._stream_count == 9).all(), restored._stream_count
+    drv2 = BulkDriver(restored)
+    res = drv2.drive(g, ap.OP_LONG_ADD, 1)
+    assert (res.results.reshape(6, 9) == 9 + np.arange(1, 10)).all()
