@@ -463,6 +463,16 @@ class BulkDriver:
 
         B = int(counts.max())
         Bpad = 1 << max(0, B - 1).bit_length()
+        # accumulators are [G, max-burst]: a skewed drive (one group with
+        # a huge burst on a large-G engine) would allocate G*Bpad
+        # regardless of total ops — refuse with advice instead of
+        # swallowing device memory
+        if G * Bpad > 64_000_000:
+            raise ValueError(
+                f"deep drive accumulators would be [{G}, {Bpad}] "
+                f"({G * Bpad / 1e6:.0f}M slots) for {n} ops — burst "
+                "sizes are too skewed; split the drive into bursts of "
+                "similar per-group size")
         resbuf = jnp.zeros((G, Bpad), jnp.int32)
         valbuf = jnp.zeros((G, Bpad), bool)
         rndbuf = jnp.full((G, Bpad), np.int32(2**30), jnp.int32)
