@@ -96,14 +96,19 @@ def _window_rank(mask: np.ndarray, starts: np.ndarray, counts: np.ndarray,
 
 
 @lru_cache(maxsize=None)
-def _deep_program(config, onehot: bool = False):
+def _deep_program(config, onehot: bool = False, donate: bool = False):
     """Jitted deep_step shared across drivers with the same static Config.
 
     ``onehot`` selects the accumulator formulation: sharded engines use
     the one-hot select-reduce (shard-local by construction — the .at[]
     scatter compiled to all-gathers of the [G,B] buffers on a mesh);
-    single-device engines keep the O(G*A) scatter."""
-    return jax.jit(partial(deep_step, config=config, onehot=onehot))
+    single-device engines keep the O(G*A) scatter (measured faster on
+    CPU; scatter never pays a collective off-mesh). ``donate`` hands
+    state + accumulators back to XLA for in-place reuse — on for
+    accelerators (saves a full state copy per round), off for CPU
+    (donation is unimplemented there and only warns)."""
+    return jax.jit(partial(deep_step, config=config, onehot=onehot),
+                   donate_argnums=(0, 1, 2, 3, 4) if donate else ())
 
 
 class BulkResult:
@@ -491,7 +496,8 @@ class BulkDriver:
             rndbuf = jax.device_put(rndbuf, sh2)
             evflag = jax.device_put(evflag, sh1)
             base_dev = jax.device_put(base_dev, sh1)
-        _deep = _deep_program(rg.config, onehot=rg.mesh is not None)
+        _deep = _deep_program(rg.config, onehot=rg.mesh is not None,
+                              donate=jax.default_backend() != "cpu")
 
         # burst-uniform payload leaves travel as SCALARS (zero H2D bytes);
         # per-op payloads fall back to full [G,S] arrays
