@@ -780,8 +780,9 @@ def run_host_read() -> dict:
 def main() -> None:
     # fail fast (exit 2) when the tunneled accelerator is unreachable —
     # a dead tunnel otherwise hangs device enumeration forever
-    from .utils.platform import require_devices
+    from .utils.platform import enable_compilation_cache, require_devices
     require_devices(env="COPYCAT_BENCH_DEVICE_TIMEOUT")
+    enable_compilation_cache()
     if SCENARIO == "election":
         result = run_election()
     elif SCENARIO == "map_read":

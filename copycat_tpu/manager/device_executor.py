@@ -330,6 +330,8 @@ class DeviceEngine:
     def _ensure(self):
         if self._groups is None:
             from ..models.raft_groups import RaftGroups
+            from ..utils.platform import enable_compilation_cache
+            enable_compilation_cache()  # restarts skip the jit stall
             cfg = self.config
             self._groups = RaftGroups(
                 cfg.capacity, cfg.num_peers, log_slots=cfg.log_slots,

@@ -288,8 +288,9 @@ def _write_artifact(result: dict) -> None:
 
 
 def main() -> None:
-    from ..utils.platform import require_devices
+    from ..utils.platform import enable_compilation_cache, require_devices
     require_devices(env="COPYCAT_VERDICT_DEVICE_TIMEOUT")
+    enable_compilation_cache()
     result = run_verdict()
     # COPYCAT_VERDICT_ARTIFACT=0 skips rewriting LINEARIZABILITY.md — the
     # committed artifact records the BENCH-scale verdict; smoke runs (CI,

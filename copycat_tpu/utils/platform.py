@@ -20,6 +20,56 @@ def honor_jax_platforms_env() -> None:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
+def enable_compilation_cache(path: str | None = None) -> str | None:
+    """Point XLA's persistent compilation cache at a stable directory.
+
+    The engine's one-time jit compile dominates server-open latency
+    (measured: ~8-9 s on CPU for the DeviceEngine step at any capacity,
+    tens of seconds for a first-ever TPU compile — see
+    ``manager/device_executor.py`` warm-up note). XLA can persist
+    compiled executables keyed by (HLO, backend, flags); with this cache
+    every later process on the machine — server restarts, bench reps,
+    recovery after a crash — skips straight to execution.
+
+    Resolution order: explicit ``path`` argument, else
+    ``COPYCAT_COMPILE_CACHE`` env (set to ``0``/empty to disable), else
+    ``~/.cache/copycat_tpu/xla``. Idempotent; returns the directory in
+    use, or ``None`` when disabled or unavailable. Safe to call before
+    backend initialization (it only sets jax config values).
+    """
+    if path is None:
+        env = os.environ.get("COPYCAT_COMPILE_CACHE")
+        if env is not None and env in ("", "0"):
+            return None
+        path = env or os.path.join(
+            os.path.expanduser("~"), ".cache", "copycat_tpu", "xla")
+    try:
+        import jax
+
+        # Never shadow a cache the operator already configured through
+        # JAX's own surface (env var or jax.config) — overriding it would
+        # silently split their fleet-shared cache.
+        theirs = (os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                  or getattr(jax.config, "jax_compilation_cache_dir", None))
+        if theirs:
+            return theirs
+        os.makedirs(path, exist_ok=True)
+
+        # The engine step takes seconds to compile, far above the 1 s
+        # default threshold — but tests/small drivers compile many tiny
+        # programs too; cache everything non-trivial. Bound the directory
+        # (LRU eviction) so months of shape-parameterized runs can't fill
+        # a dev machine's disk. The cache dir itself is set LAST so a
+        # failure on any knob leaves the cache fully disabled and the
+        # None return truthful.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_compilation_cache_max_size", 1 << 30)  # 1 GiB
+        jax.config.update("jax_compilation_cache_dir", path)
+        return path
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        return None
+
+
 # Run by subprocess probes: mirrors the parent's platform selection
 # (honor_jax_platforms_env) so the probe enumerates the same backends the
 # parent is about to.

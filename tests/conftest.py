@@ -21,5 +21,12 @@ try:
     # jax.config, which overrides the env var — override it back before any
     # backend initializes so tests run on the virtual 8-device CPU mesh.
     jax.config.update("jax_platforms", "cpu")
+
+    # Persist XLA executables across suite runs (engine steps take seconds
+    # to compile each; the cache is keyed by HLO+backend+flags so it can
+    # never serve a stale program). COPYCAT_COMPILE_CACHE=0 disables.
+    from copycat_tpu.utils.platform import enable_compilation_cache
+
+    enable_compilation_cache()
 except ImportError:  # pragma: no cover - jax is part of the baked image
     pass

@@ -26,3 +26,58 @@ def test_require_devices_passes_on_healthy_backend(monkeypatch):
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     monkeypatch.setenv("COPYCAT_DEVICE_PROBES", "1")
     require_devices()  # returns (no SystemExit) when enumeration works
+
+
+class TestCompilationCache:
+    """Precedence rules of ``enable_compilation_cache``.
+
+    The helper must (a) honor an explicit disable, (b) never shadow a
+    cache the operator configured through JAX's own surface (env var or
+    jax.config), and (c) otherwise point jax at the copycat default.
+    Config state is saved/restored because the suite's conftest already
+    enabled the default cache for this process.
+    """
+
+    def _saved(self):
+        import jax
+
+        return getattr(jax.config, "jax_compilation_cache_dir", None)
+
+    def test_disable_env(self, monkeypatch):
+        from copycat_tpu.utils.platform import enable_compilation_cache
+
+        monkeypatch.setenv("COPYCAT_COMPILE_CACHE", "0")
+        assert enable_compilation_cache() is None
+
+    def test_user_jax_env_wins(self, monkeypatch):
+        from copycat_tpu.utils.platform import enable_compilation_cache
+
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/tmp/fleet-cache")
+        assert enable_compilation_cache() == "/tmp/fleet-cache"
+
+    def test_user_jax_config_wins(self, tmp_path):
+        import jax
+
+        from copycat_tpu.utils.platform import enable_compilation_cache
+
+        saved = self._saved()
+        try:
+            jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+            assert enable_compilation_cache() == str(tmp_path)
+        finally:
+            jax.config.update("jax_compilation_cache_dir", saved)
+
+    def test_default_path_set_and_returned(self, monkeypatch, tmp_path):
+        import jax
+
+        from copycat_tpu.utils.platform import enable_compilation_cache
+
+        saved = self._saved()
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+            monkeypatch.setenv("COPYCAT_COMPILE_CACHE", str(tmp_path / "c"))
+            got = enable_compilation_cache()
+            assert got == str(tmp_path / "c")
+            assert jax.config.jax_compilation_cache_dir == got
+        finally:
+            jax.config.update("jax_compilation_cache_dir", saved)
