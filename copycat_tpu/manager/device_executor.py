@@ -82,6 +82,15 @@ class DeviceEngineConfig(NamedTuple):
     log_slots: int = 64
     submit_slots: int = 4
     seed: int = 0             # shared PRNG seed — same election history
+    # Optional jax.sharding.Mesh: shard the engine's group axis across
+    # this server's local devices (parallel/mesh.py specs — zero
+    # cross-device collectives, census-verified). A LOCAL placement
+    # choice only: sharding never changes the integer state evolution,
+    # so servers with different meshes (or none) still replicate
+    # deterministically; the uniformity requirement above is about
+    # shapes, not placement. The mesh's 'groups' axis size must divide
+    # capacity (each shard holds capacity/shards groups).
+    mesh: Any = None
 
 
 class _Job:
@@ -333,9 +342,16 @@ class DeviceEngine:
             from ..utils.platform import enable_compilation_cache
             enable_compilation_cache()  # restarts skip the jit stall
             cfg = self.config
+            if cfg.mesh is not None:
+                shards = cfg.mesh.shape.get("groups", 1)
+                if cfg.capacity % shards:
+                    raise ValueError(
+                        f"DeviceEngineConfig.capacity={cfg.capacity} not "
+                        f"divisible by the mesh 'groups' axis ({shards})")
             self._groups = RaftGroups(
                 cfg.capacity, cfg.num_peers, log_slots=cfg.log_slots,
-                submit_slots=cfg.submit_slots, seed=cfg.seed)
+                submit_slots=cfg.submit_slots, seed=cfg.seed,
+                mesh=cfg.mesh)
             # Warm-up: deterministic election rounds (fixed seed). After
             # this, full delivery keeps every leader stable, so queries are
             # always servable without stepping.

@@ -46,11 +46,16 @@ def enable_compilation_cache(path: str | None = None) -> str | None:
     try:
         import jax
 
-        # Never shadow a cache the operator already configured through
-        # JAX's own surface (env var or jax.config) — overriding it would
-        # silently split their fleet-shared cache.
+        # Never shadow a cache the operator configured through JAX's own
+        # surface (env var or jax.config) — overriding it would silently
+        # split their fleet-shared cache. A dir this helper itself set on
+        # an earlier call is NOT "theirs": an explicit ``path`` must
+        # still win over our own previous default.
+        global _cache_dir_applied
+        config_dir = getattr(jax.config, "jax_compilation_cache_dir", None)
         theirs = (os.environ.get("JAX_COMPILATION_CACHE_DIR")
-                  or getattr(jax.config, "jax_compilation_cache_dir", None))
+                  or (config_dir if config_dir != _cache_dir_applied
+                      else None))
         if theirs:
             return theirs
         os.makedirs(path, exist_ok=True)
@@ -65,9 +70,15 @@ def enable_compilation_cache(path: str | None = None) -> str | None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         jax.config.update("jax_compilation_cache_max_size", 1 << 30)  # 1 GiB
         jax.config.update("jax_compilation_cache_dir", path)
+        _cache_dir_applied = path
         return path
     except Exception:  # noqa: BLE001 — cache is an optimization only
         return None
+
+
+# The cache dir most recently set by enable_compilation_cache, so later
+# calls can tell operator config from this helper's own earlier work.
+_cache_dir_applied: str | None = None
 
 
 # Run by subprocess probes: mirrors the parent's platform selection

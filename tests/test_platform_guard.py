@@ -55,11 +55,12 @@ class TestCompilationCache:
         monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/tmp/fleet-cache")
         assert enable_compilation_cache() == "/tmp/fleet-cache"
 
-    def test_user_jax_config_wins(self, tmp_path):
+    def test_user_jax_config_wins(self, monkeypatch, tmp_path):
         import jax
 
         from copycat_tpu.utils.platform import enable_compilation_cache
 
+        monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
         saved = self._saved()
         try:
             jax.config.update("jax_compilation_cache_dir", str(tmp_path))
@@ -72,7 +73,11 @@ class TestCompilationCache:
 
         from copycat_tpu.utils.platform import enable_compilation_cache
 
+        from copycat_tpu.utils import platform
+
+        monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
         saved = self._saved()
+        saved_applied = platform._cache_dir_applied
         try:
             jax.config.update("jax_compilation_cache_dir", None)
             monkeypatch.setenv("COPYCAT_COMPILE_CACHE", str(tmp_path / "c"))
@@ -80,4 +85,28 @@ class TestCompilationCache:
             assert got == str(tmp_path / "c")
             assert jax.config.jax_compilation_cache_dir == got
         finally:
+            platform._cache_dir_applied = saved_applied
+            jax.config.update("jax_compilation_cache_dir", saved)
+
+    def test_explicit_path_beats_own_earlier_default(self, monkeypatch,
+                                                     tmp_path):
+        import jax
+
+        from copycat_tpu.utils import platform
+
+        monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+        saved = self._saved()
+        saved_applied = platform._cache_dir_applied
+        try:
+            first = str(tmp_path / "a")
+            second = str(tmp_path / "b")
+            assert platform.enable_compilation_cache(first) == first
+            # our own earlier dir is not "theirs" — explicit path wins
+            assert platform.enable_compilation_cache(second) == second
+            assert jax.config.jax_compilation_cache_dir == second
+            # but an operator-set dir (different from what we applied) is
+            jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+            assert platform.enable_compilation_cache(first) == str(tmp_path)
+        finally:
+            platform._cache_dir_applied = saved_applied
             jax.config.update("jax_compilation_cache_dir", saved)
