@@ -6,13 +6,33 @@ listener when the last local listener closes)."""
 
 from __future__ import annotations
 
+import asyncio
 from typing import Any, Callable, Generic, Iterator, TypeVar
 
 T = TypeVar("T")
 
+#: Strong references to in-flight async-callback tasks (see Listener.accept).
+_live_tasks: set = set()
+
+
+def _reap_task(task) -> None:
+    _live_tasks.discard(task)
+    if not task.cancelled() and task.exception() is not None:
+        import logging
+
+        logging.getLogger(__name__).error(
+            "async listener callback failed", exc_info=task.exception())
+
 
 class Listener(Generic[T]):
-    """A single closeable callback registration."""
+    """A single closeable callback registration.
+
+    Callbacks may be sync or async: a coroutine returned by the callback
+    is scheduled on the running event loop (event dispatch happens inside
+    the session's loop), mirroring the message-bus handler contract —
+    without this, an async callback would be silently dropped ("coroutine
+    never awaited"), a footgun for an asyncio-first API.
+    """
 
     def __init__(self, callback: Callable[[T], Any], parent: "Listeners[T] | None" = None):
         self._callback = callback
@@ -20,9 +40,19 @@ class Listener(Generic[T]):
         self._open = True
 
     def accept(self, event: T) -> Any:
-        if self._open:
-            return self._callback(event)
-        return None
+        if not self._open:
+            return None
+        result = self._callback(event)
+        if asyncio.iscoroutine(result):
+            # Strong-ref the task until done: the loop keeps only weak
+            # refs, so a suspended callback could otherwise be GC'd
+            # mid-execution. Exceptions are logged (sync callbacks raise
+            # into the emitter; async ones cannot).
+            task = asyncio.ensure_future(result)
+            _live_tasks.add(task)
+            task.add_done_callback(_reap_task)
+            return task
+        return result
 
     def close(self) -> None:
         if self._open:

@@ -37,6 +37,7 @@ def enable_compilation_cache(path: str | None = None) -> str | None:
     use, or ``None`` when disabled or unavailable. Safe to call before
     backend initialization (it only sets jax config values).
     """
+    explicit_path = path
     if path is None:
         env = os.environ.get("COPYCAT_COMPILE_CACHE")
         if env is not None and env in ("", "0"):
@@ -49,15 +50,20 @@ def enable_compilation_cache(path: str | None = None) -> str | None:
         # Never shadow a cache the operator configured through JAX's own
         # surface (env var or jax.config) — overriding it would silently
         # split their fleet-shared cache. A dir this helper itself set on
-        # an earlier call is NOT "theirs": an explicit ``path`` must
-        # still win over our own previous default.
+        # an earlier call may be replaced, but only by a NEW explicit
+        # ``path``: the no-arg calls at the entry points (server open,
+        # bench, verdict) never downgrade an earlier explicit choice to
+        # the default.
         global _cache_dir_applied
         config_dir = getattr(jax.config, "jax_compilation_cache_dir", None)
-        theirs = (os.environ.get("JAX_COMPILATION_CACHE_DIR")
-                  or (config_dir if config_dir != _cache_dir_applied
-                      else None))
-        if theirs:
-            return theirs
+        env_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        if env_dir:
+            return env_dir
+        if config_dir:
+            if config_dir != _cache_dir_applied:
+                return config_dir            # operator-set: theirs
+            if explicit_path is None:
+                return config_dir            # ours; no-arg call keeps it
         os.makedirs(path, exist_ok=True)
 
         # The engine step takes seconds to compile, far above the 1 s

@@ -213,3 +213,42 @@ async def test_tcp_transport_error_marshalling():
         await conn.send(1)
     await conn.close()
     await server.close()
+
+
+@async_test
+async def test_listeners_schedule_async_callbacks():
+    """Async callbacks registered on Listener/Listeners run to completion.
+
+    An asyncio-first API must not drop a coroutine callback on the floor
+    (the sync-only dispatch used to leave it "never awaited" — e.g. an
+    ``async def`` handed to ``on_election`` simply never fired)."""
+    import asyncio
+
+    from copycat_tpu.utils.listeners import Listeners
+
+    listeners: Listeners = Listeners()
+    got: list = []
+    done = asyncio.Event()
+
+    async def async_cb(event):
+        await asyncio.sleep(0)
+        got.append(("async", event))
+        done.set()
+
+    def sync_cb(event):
+        got.append(("sync", event))
+
+    listeners.add(sync_cb)
+    listeners.add(async_cb)
+    listeners.accept(41)
+    assert ("sync", 41) in got          # sync path unchanged, immediate
+    await asyncio.wait_for(done.wait(), 5)
+    assert ("async", 41) in got
+
+    # a closed listener's coroutine is never created
+    lst = listeners.add(async_cb)
+    lst.close()
+    done.clear()
+    listeners.accept(42)
+    await asyncio.wait_for(done.wait(), 5)  # the still-open async_cb fires
+    assert got.count(("async", 42)) == 1

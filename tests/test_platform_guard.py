@@ -101,6 +101,9 @@ class TestCompilationCache:
             first = str(tmp_path / "a")
             second = str(tmp_path / "b")
             assert platform.enable_compilation_cache(first) == first
+            # a later NO-ARG call (entry points) never downgrades an
+            # earlier explicit choice to the default
+            assert platform.enable_compilation_cache() == first
             # our own earlier dir is not "theirs" — explicit path wins
             assert platform.enable_compilation_cache(second) == second
             assert jax.config.jax_compilation_cache_dir == second
