@@ -23,4 +23,21 @@ Architecture (see SURVEY.md in the repo root):
   apply via vectorized kernels), sharded over a ``jax.sharding.Mesh``.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
+
+# Honor an explicit JAX_PLATFORMS env var BEFORE any backend can
+# initialize: accelerator plugin site config overrides the env var via
+# jax.config, and a plugin dialing a dead accelerator hangs device
+# enumeration forever — a user running any entry point (example, script,
+# server) with JAX_PLATFORMS=cpu must actually get the CPU backend.
+# (Round-3 post-mortem; same pin as tests/conftest.py and
+# __graft_entry__.dryrun_multichip.) No-op when the env var is unset,
+# and jax is only imported here when it is set.
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    from .utils.platform import honor_jax_platforms_env as _honor
+
+    _honor()
+    del _honor
+del _os

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import os
+import re
 
 
 def honor_jax_platforms_env() -> None:
@@ -66,20 +67,68 @@ def enable_compilation_cache(path: str | None = None) -> str | None:
                 return config_dir            # ours; no-arg call keeps it
         os.makedirs(path, exist_ok=True)
 
+        _trim_cache_dir(path)
+
         # The engine step takes seconds to compile, far above the 1 s
         # default threshold — but tests/small drivers compile many tiny
-        # programs too; cache everything non-trivial. Bound the directory
-        # (LRU eviction) so months of shape-parameterized runs can't fill
-        # a dev machine's disk. The cache dir itself is set LAST so a
-        # failure on any knob leaves the cache fully disabled and the
-        # None return truthful.
+        # programs too; cache everything non-trivial. NOTE: the directory
+        # is bounded by _trim_cache_dir above, NOT by jax's
+        # ``jax_compilation_cache_max_size`` — that knob turns on
+        # per-entry atime bookkeeping plus a directory-wide eviction scan
+        # under a lock file, and with several concurrent processes on one
+        # dir it produced both write-failure warnings (atime files racing
+        # the eviction) and multi-minute stalls of child processes on
+        # this machine. The cache dir itself is set LAST so a failure on
+        # any knob leaves the cache fully disabled and the None return
+        # truthful.
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-        jax.config.update("jax_compilation_cache_max_size", 1 << 30)  # 1 GiB
         jax.config.update("jax_compilation_cache_dir", path)
         _cache_dir_applied = path
         return path
     except Exception:  # noqa: BLE001 — cache is an optimization only
         return None
+
+
+#: XLA persistent-cache entry names carry a 64-hex program hash
+#: (e.g. ``jit__foo-<64 hex>-cache``); the trim below refuses to touch
+#: anything else, so a misconfigured cache path (someone's $HOME) can
+#: never lose user files.
+_CACHE_ENTRY_RE = re.compile(r".*-[0-9a-f]{64}(-cache|-atime)?$")
+
+
+def _trim_cache_dir(path: str, max_bytes: int = 1 << 30) -> None:
+    """Best-effort size bound for the cache dir: drop least-recently
+    used entries (max of atime/mtime — atime advances on cache hits
+    under relatime) until under ``max_bytes``. Runs once per process at
+    enable time — no locks, no bookkeeping files; a concurrently-deleted
+    file is simply skipped. Only files shaped like XLA cache entries are
+    ever removed, and a removed entry only costs its owner a recompile."""
+    try:
+        entries = []
+        with os.scandir(path) as it:
+            for e in it:
+                try:
+                    if not e.is_file() or not _CACHE_ENTRY_RE.match(e.name):
+                        continue
+                    st = e.stat()
+                except OSError:
+                    continue  # concurrently deleted mid-scan
+                entries.append((max(st.st_atime, st.st_mtime),
+                                st.st_size, e.path))
+        total = sum(s for _, s, _ in entries)
+        if total <= max_bytes:
+            return
+        entries.sort()  # least recently used first
+        for _, size, p in entries:
+            try:
+                os.remove(p)
+            except OSError:
+                continue
+            total -= size
+            if total <= max_bytes:
+                return
+    except OSError:
+        return
 
 
 # The cache dir most recently set by enable_compilation_cache, so later

@@ -113,3 +113,21 @@ class TestCompilationCache:
         finally:
             platform._cache_dir_applied = saved_applied
             jax.config.update("jax_compilation_cache_dir", saved)
+
+    def test_trim_only_touches_cache_entries(self, tmp_path):
+        import os
+
+        from copycat_tpu.utils import platform
+
+        h = "ab" * 32
+        for i in range(6):
+            p = tmp_path / f"jit_f{i}-{h}-cache"
+            p.write_bytes(b"x" * 100)
+            os.utime(p, (i, i))
+        precious = tmp_path / "precious.txt"
+        precious.write_bytes(b"y" * 1000)   # over budget, but NOT ours
+        platform._trim_cache_dir(str(tmp_path), max_bytes=350)
+        left = sorted(q.name for q in tmp_path.iterdir())
+        # least-recently-used cache entries dropped; user file untouched
+        assert left == [f"jit_f3-{h}-cache", f"jit_f4-{h}-cache",
+                        f"jit_f5-{h}-cache", "precious.txt"], left
