@@ -7,6 +7,7 @@ listener when the last local listener closes)."""
 from __future__ import annotations
 
 import asyncio
+import logging
 from typing import Any, Callable, Generic, Iterator, TypeVar
 
 T = TypeVar("T")
@@ -18,8 +19,6 @@ _live_tasks: set = set()
 def _reap_task(task) -> None:
     _live_tasks.discard(task)
     if not task.cancelled() and task.exception() is not None:
-        import logging
-
         logging.getLogger(__name__).error(
             "async listener callback failed", exc_info=task.exception())
 

@@ -21,6 +21,11 @@ def honor_jax_platforms_env() -> None:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
+# The cache dir most recently set by enable_compilation_cache, so later
+# calls can tell operator config from this helper's own earlier work.
+_cache_dir_applied: str | None = None
+
+
 def enable_compilation_cache(path: str | None = None) -> str | None:
     """Point XLA's persistent compilation cache at a stable directory.
 
@@ -129,11 +134,6 @@ def _trim_cache_dir(path: str, max_bytes: int = 1 << 30) -> None:
                 return
     except OSError:
         return
-
-
-# The cache dir most recently set by enable_compilation_cache, so later
-# calls can tell operator config from this helper's own earlier work.
-_cache_dir_applied: str | None = None
 
 
 # Run by subprocess probes: mirrors the parent's platform selection

@@ -15,12 +15,11 @@ if "xla_force_host_platform_device_count" not in flags:
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 try:
-    import jax
-
-    # Site customization (e.g. a TPU plugin) may pin jax_platforms via
-    # jax.config, which overrides the env var — override it back before any
-    # backend initializes so tests run on the virtual 8-device CPU mesh.
-    jax.config.update("jax_platforms", "cpu")
+    # Importing the package re-asserts JAX_PLATFORMS (set above) against
+    # plugin site config before any backend initializes — the same pin
+    # every entry point gets (copycat_tpu/__init__.py); tests run on the
+    # virtual 8-device CPU mesh.
+    import copycat_tpu  # noqa: F401
 
     # Persist XLA executables across suite runs (engine steps take seconds
     # to compile each; the cache is keyed by HLO+backend+flags so it can
