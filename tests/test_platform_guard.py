@@ -38,6 +38,14 @@ class TestCompilationCache:
     enabled the default cache for this process.
     """
 
+    @pytest.fixture(autouse=True)
+    def _hermetic_env(self, monkeypatch):
+        # precedence logic under test, not the ambient environment: a
+        # developer's COPYCAT_COMPILE_CACHE / JAX_COMPILATION_CACHE_DIR
+        # must not leak in (the cache-disabled CI run sets the former)
+        monkeypatch.delenv("COPYCAT_COMPILE_CACHE", raising=False)
+        monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+
     def _saved(self):
         import jax
 
@@ -60,7 +68,6 @@ class TestCompilationCache:
 
         from copycat_tpu.utils.platform import enable_compilation_cache
 
-        monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
         saved = self._saved()
         try:
             jax.config.update("jax_compilation_cache_dir", str(tmp_path))
@@ -75,7 +82,6 @@ class TestCompilationCache:
 
         from copycat_tpu.utils import platform
 
-        monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
         saved = self._saved()
         saved_applied = platform._cache_dir_applied
         try:
@@ -94,7 +100,6 @@ class TestCompilationCache:
 
         from copycat_tpu.utils import platform
 
-        monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
         saved = self._saved()
         saved_applied = platform._cache_dir_applied
         try:
