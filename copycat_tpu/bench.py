@@ -500,20 +500,45 @@ def run_spi() -> dict:
 
     instances = int(os.environ.get("COPYCAT_BENCH_SPI_INSTANCES", "1000"))
     bursts = int(os.environ.get("COPYCAT_BENCH_SPI_BURSTS", "5"))
+    # local (in-memory, default) | tcp (asyncio sockets) | native (C++
+    # epoll + C codec): same wire format, so the knob isolates the IO
+    # stack's share of the client-visible number
+    transport_kind = os.environ.get("COPYCAT_BENCH_SPI_TRANSPORT", "local")
     capacity = 1 << max(4, (instances - 1).bit_length())  # pow2 >= instances
+    registry = LocalServerRegistry()  # shared by both ends in local mode
+
+    def make_transport():
+        if transport_kind == "local":
+            return LocalTransport(registry)
+        if transport_kind == "tcp":
+            from .io.tcp import TcpTransport
+            return TcpTransport()
+        if transport_kind == "native":
+            from .io.native import NativeTcpTransport, native_available
+            if not native_available():
+                raise SystemExit("native transport unavailable "
+                                 "(make -C native)")
+            return NativeTcpTransport()
+        raise SystemExit(
+            f"COPYCAT_BENCH_SPI_TRANSPORT={transport_kind!r}: "
+            "local|tcp|native")
 
     async def drive() -> dict:
-        registry = LocalServerRegistry()
         addr = Address("127.0.0.1", 15999)
+        # ONE transport shared by both ends (client()/server() hand out
+        # independent endpoints): the native kind owns an epoll thread
+        # pair, and a second instance would contend for the single core
+        # this scenario documents — shut it down in the finally.
+        transport = make_transport()
         server = AtomixServer(
-            addr, [addr], LocalTransport(registry),
+            addr, [addr], transport,
             election_timeout=0.5, heartbeat_interval=0.1,
             session_timeout=60.0, executor="tpu",
             engine_config=DeviceEngineConfig(
                 capacity=capacity, num_peers=PEERS, log_slots=32,
                 submit_slots=4))
         await server.open()
-        client = AtomixClient([addr], LocalTransport(registry),
+        client = AtomixClient([addr], transport,
                               session_timeout=60.0)
         await client.open()
         try:
@@ -552,7 +577,10 @@ def run_spi() -> dict:
             rounds0 = engine._groups.rounds if engine._groups else 0
             return {
                 "metric": (f"spi_client_visible_ops_per_sec_{instances}"
-                           f"_device_instances"),
+                           f"_device_instances"
+                           + ("" if transport_kind == "local"
+                              else f"_{transport_kind}")),
+                "transport": transport_kind,
                 "value": round(max(reps), 1),
                 "unit": "ops/sec",
                 "vs_baseline": round(max(reps) / NORTH_STAR_OPS, 4),
@@ -572,6 +600,9 @@ def run_spi() -> dict:
                 await asyncio.wait_for(server.close(), 10)
             except Exception:
                 pass
+            shutdown = getattr(transport, "shutdown", None)
+            if shutdown is not None:
+                shutdown()
 
     return asyncio.run(drive())
 

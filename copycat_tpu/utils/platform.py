@@ -136,6 +136,10 @@ def _trim_cache_dir(path: str, max_bytes: int = 1 << 30) -> None:
         return
 
 
+#: Set after one successful require_devices verification (per process).
+_devices_verified: bool = False
+
+
 # Run by subprocess probes: mirrors the parent's platform selection
 # (honor_jax_platforms_env) so the probe enumerates the same backends the
 # parent is about to.
@@ -174,6 +178,20 @@ def require_devices(env: str = "COPYCAT_DEVICE_TIMEOUT",
     import sys
     import threading
     import time
+
+    # One successful verification per process is enough — entry points
+    # can layer guards (e.g. __graft_entry__'s __main__ probes, then
+    # entry() self-guards) without paying repeated subprocess probes.
+    # And a process pinned to CPU-only platforms cannot hang on an
+    # accelerator at all: skip the probe outright.
+    global _devices_verified
+    if _devices_verified:
+        return
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if platforms and all(
+            p.strip() == "cpu" for p in platforms.split(",") if p.strip()):
+        _devices_verified = True
+        return
 
     timeout_s = float(os.environ.get(env, str(default_s)))
     n_probes = max(1, int(os.environ.get(probes_env, str(default_probes))))
@@ -228,3 +246,4 @@ def require_devices(env: str = "COPYCAT_DEVICE_TIMEOUT",
         print(f"FATAL: device enumeration failed: {result['error']!r}",
               file=err, flush=True)
         raise SystemExit(2)
+    _devices_verified = True
