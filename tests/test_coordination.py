@@ -195,6 +195,13 @@ async def test_topic_pub_sub():
         await t1.sync().publish("news")
         await asyncio.wait_for(got.wait(), 5)
         assert messages == ["news"]
+
+        # async_() mode: publish completes on COMMIT (SEQUENTIAL write,
+        # reference DistributedTopic.async()); delivery still arrives
+        got.clear()
+        await t1.async_().publish("later")
+        await asyncio.wait_for(got.wait(), 5)
+        assert messages == ["news", "later"]
     finally:
         await stack.close()
 
