@@ -471,6 +471,75 @@ def run_host() -> dict:
     return out
 
 
+def run_session() -> dict:
+    """Client-visible throughput through the SESSIONED client runtime
+    (``models/session_client.BulkSessionClient`` — the unified plane,
+    VERDICT r4 #2): ``COPYCAT_BENCH_SESSIONS`` sessions over one client
+    share one deep drive per flush; every op carries (session, seq), is
+    exactly-once deduplicated, and its result is correlated into the
+    session cache. This is the reference-shaped client contract
+    (Copycat client runtime, SURVEY.md §2.3) riding the north-star
+    plane; round-5 target ≥100k committed ops/s on one chip."""
+    from .models import BulkSessionClient, RaftGroups
+
+    n_sessions = int(os.environ.get("COPYCAT_BENCH_SESSIONS", "16"))
+    rg = RaftGroups(GROUPS, PEERS, log_slots=LOG_SLOTS,
+                    submit_slots=SUBMIT_SLOTS,
+                    config=Config(use_pallas=use_pallas(),
+                                  append_window=max(4, SUBMIT_SLOTS),
+                                  applies_per_round=max(4, SUBMIT_SLOTS),
+                                  pool_budgets=POOL_BUDGETS,
+                                  resource=RESOURCE_CONFIGS["counter"],
+                                  monotone_tag_accept=True))
+    per_group = int(os.environ.get("COPYCAT_BENCH_HOST_BURST",
+                                   str(SUBMIT_SLOTS * 8)))
+    log(f"bench[session]: G={GROUPS} P={PEERS} {n_sessions} sessions, "
+        f"{per_group} ops/group/burst; "
+        f"device={jax.devices()[0].platform}")
+    rg.wait_for_leaders()
+    client = BulkSessionClient(rg)
+    sessions = [client.open_session() for _ in range(n_sessions)]
+    # each session owns an equal slice of the groups (disjoint groups
+    # keep per-session FIFO independent of scheduling order)
+    slices = np.array_split(np.arange(GROUPS), n_sessions)
+
+    def burst() -> float:
+        t0 = time.perf_counter()
+        total = 0
+        for s, sl in zip(sessions, slices):
+            seqs = s.submit_batch(np.repeat(sl, per_group),
+                                  ap.OP_LONG_ADD, 1)
+            total += seqs.size
+        n = client.flush()
+        assert n == total
+        return total / (time.perf_counter() - t0)
+
+    burst()  # warm (jit compile + first transfers)
+    best = 0.0
+    reps = []
+    for rep in range(REPEATS):
+        with xla_trace(PROFILE_DIR if rep == 0 else None):
+            ops = burst()
+        best = max(best, ops)
+        reps.append(ops)
+        log(f"bench[session]: rep {rep}: {ops:,.0f} committed "
+            f"session ops/sec client-observed")
+    # exactly-once spot check: group 0's counter equals its op count
+    s0 = sessions[0]
+    q = s0.submit(0, ap.OP_VALUE_GET)
+    client.flush()
+    expect = per_group * (len(reps) + 1)
+    assert s0.result(q) == expect, (s0.result(q), expect)
+    return {
+        "metric": f"session_committed_ops_per_sec_{GROUPS}_groups",
+        "value": round(best, 1),
+        "unit": "ops/sec",
+        "vs_baseline": round(best / NORTH_STAR_OPS, 4),
+        "sessions": n_sessions,
+        **spread(reps),
+    }
+
+
 def spread(reps: list[float]) -> dict:
     """Per-rep min/median/max so regressions are distinguishable from
     tunnel weather (±30% session swings — BENCH_SCENARIOS.md note ¹)."""
@@ -824,12 +893,14 @@ def main() -> None:
         result = run_host_read()
     elif SCENARIO == "spi":
         result = run_spi()
+    elif SCENARIO == "session":
+        result = run_session()
     elif SCENARIO in SUBMIT_BUILDERS:
         result = run_throughput(SCENARIO)
     else:
         raise SystemExit(
             f"unknown scenario {SCENARIO!r}; pick one of "
-            f"{['election', 'map_read', 'host', 'host_read', 'spi', *SUBMIT_BUILDERS]}")
+            f"{['election', 'map_read', 'host', 'host_read', 'spi', 'session', *SUBMIT_BUILDERS]}")
     print(json.dumps(result))
 
 

@@ -348,6 +348,13 @@ class DeviceEngine:
                     raise ValueError(
                         f"DeviceEngineConfig.capacity={cfg.capacity} not "
                         f"divisible by the mesh 'groups' axis ({shards})")
+                peer_shards = cfg.mesh.shape.get("peers", 1)
+                if cfg.num_peers % peer_shards:
+                    # Without this, the failure surfaces later as an
+                    # opaque XLA sharding error inside device_put.
+                    raise ValueError(
+                        f"DeviceEngineConfig.num_peers={cfg.num_peers} not "
+                        f"divisible by the mesh 'peers' axis ({peer_shards})")
             self._groups = RaftGroups(
                 cfg.capacity, cfg.num_peers, log_slots=cfg.log_slots,
                 submit_slots=cfg.submit_slots, seed=cfg.seed,

@@ -7,6 +7,11 @@ one-``ResourceManager``-per-server design, ``AtomixReplica.java:374``).
 
 from .raft_groups import RaftGroups  # noqa: F401
 from .bulk import BulkDriver, BulkResult, drive_batch  # noqa: F401
+from .session_client import (  # noqa: F401
+    BulkSession,
+    BulkSessionClient,
+    SessionEvent,
+)
 from .device_resources import (  # noqa: F401
     DeviceElection,
     DeviceLock,

@@ -58,13 +58,15 @@ def _scatter(G: int, S: int, gi, slots, vals) -> np.ndarray:
     return arr
 
 
-def stream_count_from_state(state) -> np.ndarray:
+def stream_count_from_state(state, fetch=jax.device_get) -> np.ndarray:
     """[G] max live-ring stream tag per group, from the most-advanced
     lane's log — the device-authoritative value of the monotone stream
     cursor (``RaftGroups._stream_count``). Used to resync after an
     abandoned drive and to rebuild the cursor on checkpoint restore
-    (election no-ops carry tag 0 and never inflate it)."""
-    log_tag, last = (np.asarray(x) for x in jax.device_get(
+    (election no-ops carry tag 0 and never inflate it). ``fetch``
+    overrides the device→host transfer (multihost engines pass their
+    local-block fetch so G is the process-local block)."""
+    log_tag, last = (np.asarray(x) for x in fetch(
         (state.log_tag, state.last_index)))
     G, _, L = log_tag.shape
     lane = last.argmax(axis=1)                       # [G]
@@ -139,24 +141,31 @@ class BulkResult:
 class BulkDriver:
     """Vectorized pipelined driver over one :class:`RaftGroups` batch."""
 
-    def __init__(self, rg) -> None:
-        # Single-host engines only: the bulk loop feeds host numpy
-        # straight into the step and fetches whole outputs, bypassing the
-        # multihost staging/lockstep hooks step_round routes through.
+    def __init__(self, rg, *, allow_sessions: bool = False) -> None:
+        # The CLASSIC drive feeds host numpy straight into the step and
+        # fetches whole outputs, bypassing the multihost staging/lockstep
+        # hooks step_round routes through — single-host engines only.
+        # The DEEP drive (monotone-tag engines) goes through the
+        # _stage_acc/_fetch_acc/_deep_fn/_stage_submits hooks and agrees
+        # on every stop decision, so it runs on multihost engines too.
         from .raft_groups import RaftGroups
-        if (getattr(rg, "process_count", 1) > 1
+        deep = bool(getattr(rg.config, "monotone_tag_accept", False))
+        if not deep and (
+                getattr(rg, "process_count", 1) > 1
                 or type(rg)._stage_submits is not RaftGroups._stage_submits
                 or type(rg)._fetch_outputs is not RaftGroups._fetch_outputs):
             raise NotImplementedError(
-                "BulkDriver drives single-host RaftGroups only; multihost "
-                "engines use the queue-managed lockstep path")
-        # Device-session engines need the per-round session tick (keep-
-        # alives ride the queue-managed submit path the bulk loop never
-        # drains) — refuse rather than silently expire sessions.
-        if rg._sessions is not None:
+                "the classic bulk drive needs a single-host RaftGroups; "
+                "multihost engines use the queue-managed lockstep path or "
+                "the deep drive (Config(monotone_tag_accept=True))")
+        # Device-session engines need the session tick + cleanup routing
+        # the raw bulk loop never performs — the sessioned client
+        # (models/session_client.BulkSessionClient) takes that duty and
+        # opts in; refuse otherwise rather than silently expire sessions.
+        if rg._sessions is not None and not allow_sessions:
             raise NotImplementedError(
-                "BulkDriver does not pump device sessions; use the "
-                "queue-managed path (step_round) on session engines")
+                "BulkDriver does not pump device sessions; drive session "
+                "engines through models.session_client.BulkSessionClient")
         self._rg = rg
 
     def drive(self, groups, opcode, a=0, b=0, c=0,
@@ -319,6 +328,11 @@ class BulkDriver:
         query calls (plus settle rounds only when slots go unserved).
         """
         rg = self._rg
+        if getattr(rg, "process_count", 1) > 1:
+            raise NotImplementedError(
+                "drive_queries is single-host; multihost engines serve "
+                "reads through the lockstep query lane (serve_query / "
+                "submit_query)")
         from ..ops.apply import QUERY_OPCODES
 
         g_arr = np.asarray(groups, np.int64).ravel()
@@ -403,8 +417,9 @@ class BulkDriver:
         Exact in the deep plane's fault-free world; an error path only
         (one [G,P,L] fetch)."""
         rg = self._rg
-        rg._stream_count = np.maximum(rg._stream_count,
-                                      stream_count_from_state(rg.state))
+        rg._stream_count = np.maximum(
+            rg._stream_count,
+            stream_count_from_state(rg.state, fetch=rg._fetch_acc))
 
     def _drive_deep(self, g_arr, op_a, a_a, b_a, c_a,
                     max_rounds: int, t0: float) -> BulkResult:
@@ -435,10 +450,7 @@ class BulkDriver:
         S = rg.submit_slots
         G = rg.num_groups
         n = g_arr.size
-        if n == 0:
-            z = np.zeros(0, np.int64)
-            return BulkResult(results=z, rounds=0, wall_s=0.0,
-                              dispatch_round=z, resolve_round=z)
+        multi = getattr(rg, "process_count", 1) > 1
 
         order = np.argsort(g_arr, kind="stable")
         g_s = g_arr[order]
@@ -450,7 +462,12 @@ class BulkDriver:
         seg_groups = g_s[starts]
         rank = np.arange(n) - np.repeat(starts, counts)
         seg_base = rg._stream_count[seg_groups]            # [nseg]
-        if (seg_base + counts).max() > np.iinfo(np.int32).max:
+        # tag-space check on an AGREED value: a per-process-local raise
+        # before the collectives below would leave peer processes hung
+        # in their allgather — every process must see the same verdict
+        tag_end = rg._global_max_int(
+            int((seg_base + counts).max(initial=0)) if n else 0)
+        if tag_end > np.iinfo(np.int32).max:
             raise OverflowError(
                 "per-group stream exceeds int32 tag space")
 
@@ -464,47 +481,46 @@ class BulkDriver:
         # On-device result accumulators, fetched ONCE per drive: [G, B]
         # keyed by stream rank (ops/consensus.deep_step). B pads to a
         # power of two so repeated drives reuse the compiled program.
-        import jax.numpy as jnp
-
-        B = int(counts.max())
+        # B is agreed ACROSS processes (multihost engines launch one
+        # collective program, so every process must size — and compile —
+        # identical buffers; a process with fewer local ops dispatches
+        # empty windows for the surplus rounds).
+        B = rg._global_max_int(int(counts.max(initial=0)))
+        if B == 0:   # agreed: every process is idle this drive
+            z = np.zeros(0, np.int64)
+            return BulkResult(results=z, rounds=0, wall_s=0.0,
+                              dispatch_round=z, resolve_round=z)
         Bpad = 1 << max(0, B - 1).bit_length()
         # accumulators are [G, max-burst]: a skewed drive (one group with
         # a huge burst on a large-G engine) would allocate G*Bpad
         # regardless of total ops — refuse with advice instead of
         # swallowing device memory
-        if G * Bpad > 64_000_000:
+        G_total = getattr(rg, "global_groups", G)
+        if G_total * Bpad > 64_000_000:
             raise ValueError(
-                f"deep drive accumulators would be [{G}, {Bpad}] "
-                f"({G * Bpad / 1e6:.0f}M slots) for {n} ops — burst "
+                f"deep drive accumulators would be [{G_total}, {Bpad}] "
+                f"({G_total * Bpad / 1e6:.0f}M slots) for {n} ops — burst "
                 "sizes are too skewed; split the drive into bursts of "
                 "similar per-group size")
-        resbuf = jnp.zeros((G, Bpad), jnp.int32)
-        valbuf = jnp.zeros((G, Bpad), bool)
-        rndbuf = jnp.full((G, Bpad), np.int32(2**30), jnp.int32)
-        evflag = jnp.zeros(G, bool)  # per-group: no cross-shard reduce
-        base_dev = jax.device_put(rg._stream_count.astype(np.int32))
-        if rg.mesh is not None:
-            # sharded engines: the accumulators live group-sharded like
-            # the state, so the scatter in deep_step stays local to each
-            # shard (placement-only, same rule as parallel/mesh.py)
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            g_ax = "groups" if "groups" in rg.mesh.axis_names else None
-            sh2 = NamedSharding(rg.mesh, P(g_ax, None))
-            sh1 = NamedSharding(rg.mesh, P(g_ax))
-            resbuf = jax.device_put(resbuf, sh2)
-            valbuf = jax.device_put(valbuf, sh2)
-            rndbuf = jax.device_put(rndbuf, sh2)
-            evflag = jax.device_put(evflag, sh1)
-            base_dev = jax.device_put(base_dev, sh1)
-        _deep = _deep_program(rg.config, onehot=rg.mesh is not None,
-                              donate=jax.default_backend() != "cpu")
+        resbuf = rg._stage_acc(np.zeros((G, Bpad), np.int32))
+        valbuf = rg._stage_acc(np.zeros((G, Bpad), bool))
+        rndbuf = rg._stage_acc(np.full((G, Bpad), 2**30, np.int32))
+        evflag = rg._stage_acc(np.zeros(G, bool))  # per-group: no
+        #                                            cross-shard reduce
+        base_dev = rg._stage_acc(rg._stream_count.astype(np.int32))
+        _deep = rg._deep_fn()
 
         # burst-uniform payload leaves travel as SCALARS (zero H2D bytes);
-        # per-op payloads fall back to full [G,S] arrays
+        # per-op payloads fall back to full [G,S] arrays. Multihost
+        # engines always stage full arrays: _stage_submits assembles a
+        # global sharded array from each process's local block, and a
+        # scalar has no local block (payload uniformity is also a
+        # per-process fact the other processes can't see).
         def _const(x):
-            return np.int32(x[0]) if (x == x[0]).all() else None
+            return np.int32(x[0]) if (n and (x == x[0]).all()) else None
 
-        consts = tuple(map(_const, (op_s, a_s, b_s, c_s)))
+        consts = ((None,) * 4 if multi
+                  else tuple(map(_const, (op_s, a_s, b_s, c_s))))
         vals = (op_s, a_s, b_s, c_s)
         deliver = rg.deliver
         ev_stash: list[Any] = []
@@ -518,8 +534,9 @@ class BulkDriver:
 
         def dispatch(tagl, vnp, leaves) -> None:
             nonlocal r, resbuf, valbuf, rndbuf, evflag
-            sub = Submits(opcode=leaves[0], a=leaves[1], b=leaves[2],
-                          c=leaves[3], tag=tagl, valid=vnp)
+            sub = rg._stage_submits(
+                Submits(opcode=leaves[0], a=leaves[1], b=leaves[2],
+                        c=leaves[3], tag=tagl, valid=vnp))
             rg._key, key = jax.random.split(rg._key)
             (rg.state, resbuf, valbuf, rndbuf, evflag, out) = _deep(
                 rg.state, resbuf, valbuf, rndbuf, evflag, base_dev,
@@ -531,12 +548,13 @@ class BulkDriver:
             r += 1
 
         _idle = (np.zeros((G, 1), np.int32), np.zeros((G, S), bool),
-                 (np.int32(0),) * 4)
+                 (np.zeros((G, S), np.int32),) * 4 if multi
+                 else (np.int32(0),) * 4)
 
         def harvest() -> None:
             """ONE fetch of the [G,B] accumulators (+ events, rare)."""
             nonlocal evflag
-            res_np, val_np, rnd_np, ev = jax.device_get(
+            res_np, val_np, rnd_np, ev = rg._fetch_acc(
                 (resbuf, valbuf, rndbuf, evflag))
             colm = np.arange(Bpad)[None, :] < counts[:, None]
             resolved[:] = val_np[seg_groups][colm]
@@ -544,12 +562,12 @@ class BulkDriver:
             resolve_round[:] = rnd_np[seg_groups][colm]
             if ev.any():
                 # rare path (session-event ops in the burst): fetch the
-                # stashed per-round event leaves and ingest with seq dedup
-                for leaves in jax.device_get(ev_stash):
+                # stashed per-round event leaves and ingest with seq
+                # dedup. Local-only decision — the fetch reads only this
+                # process's shards, no collective program is launched.
+                for leaves in (rg._fetch_acc(st) for st in ev_stash):
                     rg._ingest_events(_EventView(*leaves))
-                evflag = jnp.zeros(G, bool)
-                if rg.mesh is not None:
-                    evflag = jax.device_put(evflag, sh1)
+                evflag = rg._stage_acc(np.zeros(G, bool))
             ev_stash.clear()
 
         # phase 1: blind pipelined dispatch — NO device fetch at all. The
@@ -573,8 +591,11 @@ class BulkDriver:
         # Resolution is a per-group PREFIX (the gate makes acceptance a
         # prefix and applies report in log order), so the cursor is the
         # per-group resolved count; re-sending an already-accepted op is
-        # rejected on device, never re-applied.
-        while not resolved.all():
+        # rejected on device, never re-applied. The stop decision is
+        # lockstep-agreed: a process whose local ops are done keeps
+        # dispatching EMPTY windows until every process is done (each
+        # iteration launches 3 collective rounds + a fetch on multihost).
+        while not rg._agree(bool(resolved.all())):
             if r > max_rounds:
                 missing = int(n - resolved.sum())
                 # abandoning mid-stream: tags up to the device ring max
@@ -607,7 +628,8 @@ class BulkDriver:
             dispatch(*_idle[:2], _idle[2])
             harvest()
 
-        rg._stream_count[seg_groups] += counts
+        if n:
+            rg._stream_count[seg_groups] += counts
         rg.rounds += r
         rg.metrics.counter("ops_committed").inc(n)
         out_res = np.zeros(n, np.int64)

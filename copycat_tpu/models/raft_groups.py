@@ -347,6 +347,45 @@ class RaftGroups:
         results, served = self._query(self.state, sub, atomic)
         return np.asarray(results), np.asarray(served)
 
+    # Deep-plane hooks (models/bulk.py _drive_deep): accumulator staging,
+    # fetch, and the jitted deep program. The multihost subclass overrides
+    # them to assemble/fetch global group-sharded arrays and to pin output
+    # shardings, which is what lifts the deep pipelined drive to
+    # multi-process engines (VERDICT r4 directive 2).
+
+    def _global_max_int(self, v: int) -> int:
+        """Max of ``v`` across processes (identity on one host) — sizes
+        the deep drive's shared accumulator width so every process
+        compiles/launches the same program."""
+        return v
+
+    def _stage_acc(self, arr: np.ndarray) -> Any:
+        """Host numpy -> device array for a deep-drive accumulator whose
+        leading axis is groups. On a single-host mesh the group axis is
+        sharded like the state (placement-only, so the deep_step scatter
+        stays shard-local — parallel/mesh.py rule)."""
+        import jax.numpy as jnp
+        x = jnp.asarray(arr)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            g_ax = "groups" if "groups" in self.mesh.axis_names else None
+            spec = P(g_ax, *([None] * (arr.ndim - 1)))
+            x = jax.device_put(x, NamedSharding(self.mesh, spec))
+        return x
+
+    def _fetch_acc(self, arrays: Any) -> Any:
+        """Fetch a pytree of group-leading device arrays to host numpy
+        (this process's local block on multihost)."""
+        return jax.device_get(arrays)
+
+    def _deep_fn(self) -> Any:
+        """The jitted ``deep_step`` used by the deep drive. One-hot
+        accumulator formulation on a mesh (shard-local by construction);
+        donation on accelerators only (unimplemented on CPU)."""
+        from .bulk import _deep_program
+        return _deep_program(self.config, onehot=self.mesh is not None,
+                             donate=jax.default_backend() != "cpu")
+
     def step_round(self, submits: Submits | None = None,
                    deliver: Any | None = None) -> StepOutputs:
         """Advance every group one round; harvests results into ``results``."""

@@ -1,0 +1,377 @@
+"""Sessioned batch client over the bulk/deep pipeline (plane unification).
+
+The reference has ONE client runtime — sessioned, sequenced,
+exactly-once, any topology (the Copycat client consumed per SURVEY.md
+§2.3; ``Atomix.java:205`` is its data path). Round 4 left this repo with
+two planes that did not compose: the deep bulk plane (≥1M client-visible
+ops/s, sessionless) and the queue-managed/SPI plane (sessions + events,
+orders of magnitude slower). This module composes them: a batched
+SESSION client whose commands carry (session, seq), are deduplicated
+exactly-once, and commit through the pipelined bulk drive — the
+reference's client contract riding the plane that meets the north star.
+
+Contract (reference parity — Copycat client runtime semantics):
+
+- **per-session/per-group FIFO**: a session's commands to one group
+  apply in submission order (the drive schedules each group's ops in
+  batch order; on monotone-tag engines the device gate enforces it).
+  Groups are independent replicated state machines, so cross-group
+  order is not defined — the analogue of the reference's per-cluster
+  session sequencing.
+- **exactly-once**: retransmits inside the drive protocol never
+  double-apply. On monotone engines this is DEVICE-enforced (the tag
+  gate rejects any duplicate whose original can still commit —
+  ``ops/consensus.py``); on classic engines it is the provable-loss
+  retry (``raft_groups._harvest``). Results are cached per
+  (session, seq): :meth:`BulkSession.result` correlates any number of
+  times, the reference's response-caching session contract
+  (``SURVEY.md §2.3 session protocol``).
+- **session events**: per-group event streams (lock grants, election
+  fire, topic messages) are delivered to session listeners in seq
+  order with per-listener cursors (``Listeners`` registrations, closeable
+  like the reference's).
+- **liveness**: keep-alives ride every flush — all sessions of one
+  client share the client runtime, as the reference's sessions share
+  their client's connection. A session whose client stops flushing
+  expires through :class:`~copycat_tpu.models.sessions.DeviceSessionRegistry`
+  and its lock/election interests are released THROUGH THE LOG
+  (deterministic fan-out); on monotone engines the cleanup ops are
+  drained by the next flush of any surviving client.
+
+Throughput: all sessions' pending commands flush as ONE bulk drive
+(deep mode on monotone engines: zero blocking fetches per round, one
+result fetch per flush), with per-op bookkeeping held to numpy slicing
++ one dict update per op. Measured by the ``session`` bench scenario
+(BENCH_SCENARIOS.md); the round-5 target is ≥100k client-visible
+committed ops/s on one chip through THIS sessioned surface.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import numpy as np
+
+from ..utils.listeners import Listener, Listeners
+from .bulk import BulkDriver
+from .sessions import DeviceSession, SessionExpiredError
+
+
+class CommandIndeterminateError(RuntimeError):
+    """The drive carrying this command was abandoned (fault-envelope
+    violation): the command MAY have applied. The reference surfaces the
+    same indeterminacy when a session dies mid-command (Copycat's
+    command failure on session loss); correlate a fresh read to learn
+    the state."""
+
+
+class SessionEvent(NamedTuple):
+    """One replicated session event, as delivered to listeners."""
+
+    group: int
+    seq: int      # absolute per-group event seq (dedup key)
+    code: int     # ops.apply.EV_* code
+    target: int   # e.g. granted holder id; -1 = broadcast
+    arg: int
+
+
+#: result-cache sentinels (identity-compared in BulkSession.result)
+_INDETERMINATE = object()
+_EXPIRED = object()
+
+
+class _Chunk(NamedTuple):
+    """One buffered batch of commands (vectorized submission unit)."""
+
+    seq0: int
+    groups: np.ndarray
+    opcode: np.ndarray
+    a: np.ndarray
+    b: np.ndarray
+    c: np.ndarray
+
+
+class BulkSession:
+    """One sessioned client identity over a :class:`BulkSessionClient`.
+
+    ``id`` doubles as the lock-holder / election-candidate id for ops
+    submitted through this session (the reference's "state is keyed by
+    sessions" discipline), so registry expiry can release exactly this
+    session's interests.
+    """
+
+    def __init__(self, client: "BulkSessionClient",
+                 dev: DeviceSession) -> None:
+        self._client = client
+        self._dev = dev
+        self.id = dev.id
+        self._next_seq = 0
+        self._pending: list[_Chunk] = []
+        self._results: dict[int, int] = {}      # seq -> result (cache)
+        # group -> (Listeners, last-delivered event seq)
+        self._subs: dict[int, tuple[Listeners, int]] = {}
+
+    # -- command submission (buffered; committed by client.flush()) -------
+
+    def submit(self, group: int, opcode: int, a: int = 0, b: int = 0,
+               c: int = 0) -> int:
+        """Buffer one command; returns its session sequence number.
+
+        The seq is assigned exactly once — a client-level retry is a
+        re-read of :meth:`result`, never a re-submit, so the op can
+        never double-apply through this API.
+        """
+        return int(self.submit_batch([group], opcode, a, b, c)[0])
+
+    def submit_batch(self, groups, opcode, a=0, b=0, c=0) -> np.ndarray:
+        """Vectorized submit: one command per entry of ``groups``
+        (scalars broadcast); returns the assigned seqs. The per-op cost
+        is pure numpy — this is the API the ≥100k ops/s surface uses."""
+        self._check_open()
+        g = np.asarray(groups, np.int64).ravel()
+        n = g.size
+        bc = lambda x: np.broadcast_to(
+            np.asarray(x, np.int32).ravel(), (n,)).copy()
+        chunk = _Chunk(self._next_seq, g, bc(opcode), bc(a), bc(b), bc(c))
+        self._next_seq += n
+        if n:
+            self._pending.append(chunk)
+        return np.arange(chunk.seq0, chunk.seq0 + n)
+
+    def lock_acquire(self, group: int, timeout_ticks: int = -1) -> int:
+        """Convenience: queue a lock acquire keyed by THIS session (and
+        bind the interest so expiry releases it)."""
+        from ..ops import apply as ops
+        self._dev.bind(group, "lock")
+        return self.submit(group, ops.OP_LOCK_ACQUIRE, self.id,
+                           timeout_ticks)
+
+    def elect_listen(self, group: int) -> int:
+        from ..ops import apply as ops
+        self._dev.bind(group, "election")
+        return self.submit(group, ops.OP_ELECT_LISTEN, self.id)
+
+    # -- result correlation (exactly-once read side) ----------------------
+
+    def result(self, seq: int) -> int:
+        """Committed result of command ``seq``. Raises ``KeyError`` while
+        the command is still buffered/in-flight (flush first);
+        :class:`CommandIndeterminateError` if the drive carrying it was
+        abandoned; :class:`SessionExpiredError` if the session died
+        before the command committed."""
+        val = self._results[seq]
+        if val is _INDETERMINATE:
+            raise CommandIndeterminateError(
+                f"session {self.id} seq {seq}: drive abandoned; the "
+                "command may or may not have applied")
+        if val is _EXPIRED:
+            raise SessionExpiredError(
+                f"session {self.id} expired before seq {seq} committed")
+        return val
+
+    def results_window(self, seq0: int, n: int) -> np.ndarray:
+        """Vectorized :meth:`result` for a contiguous seq window."""
+        return np.fromiter((self.result(s) for s in range(seq0, seq0 + n)),
+                           np.int64, n)
+
+    # -- queries (no log append) ------------------------------------------
+
+    def query_batch(self, groups, opcode, a=0, b=0, c=0,
+                    consistency: str = "sequential") -> np.ndarray:
+        """Serve reads through the query lane (no log entry), at the
+        requested consistency (``"atomic"`` = leader-lease linearizable
+        — reference ``Consistency.java:157-176``). Counts as session
+        activity (keep-alive)."""
+        self._check_open()
+        self._client._registry.keep_alive(self.id)
+        return self._client._driver.drive_queries(
+            groups, opcode, a, b, c, consistency=consistency)
+
+    # -- events ------------------------------------------------------------
+
+    def on_event(self, group: int, callback: Callable[[SessionEvent], Any]
+                 ) -> Listener:
+        """Register a listener for ``group``'s session events; delivery
+        happens during :meth:`BulkSessionClient.flush`, in event-seq
+        order, starting from events newer than registration time."""
+        listeners, cursor = self._subs.get(group, (None, None))
+        if listeners is None:
+            evs = self._client._rg.events.get(group, [])
+            listeners = Listeners()
+            cursor = evs[-1][0] if evs else -1
+            self._subs[group] = (listeners, cursor)
+        return listeners.add(callback)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def is_open(self) -> bool:
+        return not (self._dev.expired or self._dev.closed)
+
+    def keep_alive(self) -> None:
+        self._dev.keep_alive()
+
+    def close(self) -> None:
+        """Graceful close: deterministic release of every bound interest
+        (same fan-out as expiry), committed by the next flush."""
+        if self.is_open:
+            self._dev.close()
+            self._client._closed.append(self)
+
+    def _check_open(self) -> None:
+        if not self.is_open:
+            raise SessionExpiredError(f"session {self.id} is dead")
+
+
+class BulkSessionClient:
+    """The unified client runtime: sessions + exactly-once + events over
+    the pipelined bulk drive (deep mode on monotone-tag engines).
+
+    One client per process/engine is the intended shape (the reference's
+    ``AtomixClient`` with many sessions over one connection). All
+    sessions' buffered commands commit in ONE drive per :meth:`flush`.
+    """
+
+    def __init__(self, rg) -> None:
+        self._rg = rg
+        self._driver = BulkDriver(rg, allow_sessions=True)
+        self._registry = rg.sessions            # instantiates lazily
+        self._sessions: dict[int, BulkSession] = {}
+        self._closed: list[BulkSession] = []
+
+    # -- sessions ----------------------------------------------------------
+
+    def open_session(self) -> BulkSession:
+        s = BulkSession(self, self._registry.open_session())
+        self._sessions[s.id] = s
+        return s
+
+    # -- the data path -----------------------------------------------------
+
+    def flush(self, max_rounds: int = 10_000) -> int:
+        """Commit every session's buffered commands in one bulk drive;
+        correlate results, run session housekeeping (keep-alives, expiry
+        fan-out, cleanup commits), deliver events. Returns the number of
+        session commands committed."""
+        rg = self._rg
+        # 1. liveness: flushing proves this client's sessions are alive
+        #    (they share this runtime), exactly like the reference's
+        #    connection-level keep-alive covering all its sessions.
+        for s in self._sessions.values():
+            if s.is_open:
+                self._registry.keep_alive(s.id)
+        # 2. expiry sweep — fans out cleanup ops for dead sessions
+        #    (pending_cleanup on monotone engines, submit queues on
+        #    classic ones).
+        self._registry.tick()
+
+        # 3. gather: session chunks + staged cleanup ops, one drive.
+        #    A gracefully CLOSED session's buffered commands still
+        #    commit (they were accepted before close; its release
+        #    fan-out rides the same drive, behind them in batch order).
+        #    An EXPIRED session's buffered commands do NOT — its
+        #    interests were already released, so applying them now would
+        #    reorder against its own cleanup; they fail as
+        #    SessionExpiredError (the reference's unknown-session
+        #    command failure).
+        chunks: list[tuple[BulkSession | None, _Chunk]] = []
+        for s in list(self._sessions.values()):
+            if s._dev.expired:
+                for ch in s._pending:
+                    s._results.update(
+                        (q, _EXPIRED)
+                        for q in range(ch.seq0, ch.seq0 + ch.groups.size))
+                s._pending = []
+                self._sessions.pop(s.id, None)
+                continue
+            for ch in s._pending:
+                chunks.append((s, ch))
+            s._pending = []
+        for s in self._closed:
+            self._sessions.pop(s.id, None)
+        self._closed.clear()
+        cleanup = self._registry.pending_cleanup
+        if cleanup:
+            cl = np.asarray(cleanup, np.int64)
+            chunks.append((None, _Chunk(0, cl[:, 0],
+                                        cl[:, 1].astype(np.int32),
+                                        cl[:, 2].astype(np.int32),
+                                        np.zeros(len(cl), np.int32),
+                                        np.zeros(len(cl), np.int32))))
+            self._registry.pending_cleanup = []
+
+        committed = 0
+        if chunks or getattr(rg, "process_count", 1) > 1:
+            cat = lambda i: (np.concatenate([c[i] for _, c in chunks])
+                             if chunks else np.zeros(0, np.int64))
+            try:
+                res = self._driver.drive(cat(1), cat(2), cat(3), cat(4),
+                                         cat(5), max_rounds=max_rounds)
+            except Exception:
+                # Abandoned drive (fault-envelope violation). Cleanup ops
+                # are RE-STAGED — CANCEL/RELEASE/RESIGN are idempotent
+                # no-ops when already applied, so retrying them is always
+                # safe, and dropping them would wedge a dead session's
+                # locks forever. Session commands are INDETERMINATE (they
+                # may have committed); mark them so result() reports the
+                # truth instead of a bare KeyError.
+                if cleanup:
+                    self._registry.pending_cleanup = (
+                        cleanup + self._registry.pending_cleanup)
+                for s, ch in chunks:
+                    if s is not None:
+                        s._results.update(
+                            (q, _INDETERMINATE)
+                            for q in range(ch.seq0,
+                                           ch.seq0 + ch.groups.size))
+                raise
+            # 4. correlate: slice results back per chunk, cache by seq.
+            off = 0
+            for s, ch in chunks:
+                n = ch.groups.size
+                if s is not None:
+                    vals = res.results[off:off + n]
+                    s._results.update(
+                        zip(range(ch.seq0, ch.seq0 + n), vals.tolist()))
+                    committed += n
+                off += n
+        # 5. classic engines: expiry fan-out rode the queue-managed path;
+        #    pump it so releases land now, not at an arbitrary later step.
+        #    (Lockstep-agreed: step_round is a collective program on
+        #    multihost engines, so all processes pump together.)
+        pump = 0
+        while rg._any_across(bool(rg._queues)) and pump < 16:
+            rg.step_round()
+            pump += 1
+        # 6. events (the drive ingested them into rg.events with seq
+        #    dedup): deliver to listeners in order, per-group cursors.
+        self._deliver_events()
+        return committed
+
+    def _deliver_events(self) -> None:
+        for s in self._sessions.values():
+            for group, (listeners, cursor) in list(s._subs.items()):
+                if not len(listeners):
+                    continue
+                new_cursor = cursor
+                try:
+                    for seq, code, target, arg in self._rg.events.get(
+                            group, []):
+                        if seq <= cursor:
+                            continue
+                        # cursor advances BEFORE dispatch: a sync
+                        # listener that raises (into the emitter, the
+                        # Listeners contract) must not cause redelivery
+                        # of already-delivered events on the next flush
+                        new_cursor = seq
+                        listeners.accept(
+                            SessionEvent(group, seq, code, target, arg))
+                finally:
+                    if new_cursor != cursor:
+                        s._subs[group] = (listeners, new_cursor)
+
+    def close(self) -> None:
+        """Close every session and commit their cleanup."""
+        for s in list(self._sessions.values()):
+            s.close()
+        self.flush()

@@ -86,6 +86,11 @@ class DeviceSessionRegistry:
         self._interests: dict[int, set[tuple[int, str]]] = {}
         self._pinned: dict[int, int] = {}           # sid -> in-flight calls
         self._cleanup_tags: set[int] = set()        # fan-out op tags to reap
+        #: (group, opcode, sid) cleanup ops awaiting a bulk drive —
+        #: monotone-tag engines refuse queue-managed submits, so expiry
+        #: fan-out is staged here and committed by the sessioned bulk
+        #: client's next flush (log-ordered there like any other op).
+        self.pending_cleanup: list[tuple[int, int, int]] = []
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -161,10 +166,13 @@ class DeviceSessionRegistry:
                 # with every concurrent grant, so there is no window in
                 # which a racing grant can leak to the dead session: if
                 # the grant commits first, the RELEASE behind it frees it.
-                self._cleanup_tags.add(
-                    self._groups.submit(group, ops.OP_LOCK_CANCEL, sid))
-                self._cleanup_tags.add(
-                    self._groups.submit(group, ops.OP_LOCK_RELEASE, sid))
+                self._submit_cleanup(group, ops.OP_LOCK_CANCEL, sid)
+                self._submit_cleanup(group, ops.OP_LOCK_RELEASE, sid)
             elif kind == "election":
-                self._cleanup_tags.add(
-                    self._groups.submit(group, ops.OP_ELECT_RESIGN, sid))
+                self._submit_cleanup(group, ops.OP_ELECT_RESIGN, sid)
+
+    def _submit_cleanup(self, group: int, opcode: int, sid: int) -> None:
+        if self._groups.config.monotone_tag_accept:
+            self.pending_cleanup.append((group, opcode, sid))
+        else:
+            self._cleanup_tags.add(self._groups.submit(group, opcode, sid))

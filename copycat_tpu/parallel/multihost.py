@@ -137,6 +137,9 @@ class MultiHostRaftGroups(RaftGroups):
                                         config=self.config),
                                 out_shardings=state_sh)
         self._global_any = jax.jit(jnp.any)
+        self._state_sh = state_sh
+        self._out_sh = out_sh
+        self._deep_jit = None   # built on first deep drive (_deep_fn)
 
     # -- staging/fetch hooks: local block <-> global sharded arrays ------
 
@@ -179,6 +182,48 @@ class MultiHostRaftGroups(RaftGroups):
         results, served = self._query(self.state, self._stage_submits(sub),
                                       g_atomic)
         return self._local_block(results), self._local_block(served)
+
+    # -- deep-plane hooks (models/bulk.py _drive_deep) --------------------
+    # The deep drive stages submits through _stage_submits (above) and
+    # everything else through these: accumulators become GLOBAL
+    # group-sharded arrays assembled from each process's local block,
+    # fetches return only addressable shards, and the deep program pins
+    # its output shardings (an unpinned output is free to replicate,
+    # which would break the shard-concat fetch).
+
+    def _global_max_int(self, v: int) -> int:
+        from jax.experimental import multihost_utils
+        return int(np.asarray(multihost_utils.process_allgather(
+            np.asarray(v, np.int64))).max())
+
+    def _stage_acc(self, arr: np.ndarray):
+        spec = P("groups", *([None] * (arr.ndim - 1)))
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, spec), np.ascontiguousarray(arr))
+
+    def _fetch_acc(self, arrays):
+        for leaf in jax.tree.leaves(arrays):
+            for s in leaf.addressable_shards:
+                s.data.copy_to_host_async()
+        return jax.tree.map(self._local_block, arrays)
+
+    def _deep_fn(self):
+        if self._deep_jit is None:
+            from ..ops.consensus import deep_step
+            acc2 = NamedSharding(self.mesh, P("groups", None))
+            acc1 = NamedSharding(self.mesh, P("groups"))
+            # donation mirrors the single-host deep program: state +
+            # accumulators are handed back to XLA for in-place reuse on
+            # accelerators (saves a full sharded-state copy per round);
+            # unimplemented on CPU, where it would only warn
+            donate = ((0, 1, 2, 3, 4)
+                      if jax.default_backend() != "cpu" else ())
+            self._deep_jit = jax.jit(
+                partial(deep_step, config=self.config, onehot=True),
+                donate_argnums=donate,
+                out_shardings=(self._state_sh, acc2, acc2, acc2, acc1,
+                               self._out_sh))
+        return self._deep_jit
 
     # -- lockstep agreement primitives -------------------------------------
     # The base driver loops (run_until, wait_for_leaders, serve_query,

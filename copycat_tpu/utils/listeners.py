@@ -47,6 +47,19 @@ class Listener(Generic[T]):
             # refs, so a suspended callback could otherwise be GC'd
             # mid-execution. Exceptions are logged (sync callbacks raise
             # into the emitter; async ones cannot).
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                # Off-loop dispatch: there is nowhere to schedule the
+                # coroutine. Log-and-drop instead of raising into the
+                # emitter (which is usually a transport/session internals
+                # path that cannot handle listener failures).
+                result.close()
+                logging.getLogger(__name__).error(
+                    "async listener callback dropped: no running event "
+                    "loop at dispatch (register sync callbacks for "
+                    "off-loop emitters)")
+                return None
             task = asyncio.ensure_future(result)
             _live_tasks.add(task)
             task.add_done_callback(_reap_task)

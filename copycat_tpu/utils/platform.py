@@ -63,7 +63,10 @@ def enable_compilation_cache(path: str | None = None) -> str | None:
         global _cache_dir_applied
         config_dir = getattr(jax.config, "jax_compilation_cache_dir", None)
         env_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
-        if env_dir:
+        if env_dir and explicit_path is None:
+            # JAX's own env var is operator config too — but only a
+            # no-arg call defers to it; an explicit ``path`` argument is
+            # the stronger, in-process operator statement and wins.
             return env_dir
         if config_dir:
             if config_dir != _cache_dir_applied:

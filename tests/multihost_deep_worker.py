@@ -1,0 +1,74 @@
+"""SPMD worker for the multihost DEEP-drive test (VERDICT r4 #2): both
+processes run THIS program over a 2-process × 4-virtual-CPU-device mesh
+with a monotone-tag engine, and drive it through the SESSIONED bulk
+client — the unified plane (sessions + deep pipeline + multihost) in
+one program. Asymmetric per-process loads exercise the agreed
+accumulator sizing and the empty-window padding (process 1 submits a
+quarter of process 0's ops, and one wave is entirely empty on
+process 1). Launched by tests/test_multihost.py."""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from copycat_tpu.models import BulkSessionClient  # noqa: E402
+from copycat_tpu.ops import apply as ap  # noqa: E402
+from copycat_tpu.ops.consensus import Config  # noqa: E402
+from copycat_tpu.parallel import multihost  # noqa: E402
+
+
+def main() -> None:
+    coord, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    multihost.initialize(coord, num_processes=nproc, process_id=pid)
+    rg = multihost.MultiHostRaftGroups(
+        groups_per_process=4, num_peers=3, log_slots=32,
+        config=Config(monotone_tag_accept=True))
+    rg.wait_for_leaders()
+
+    client = BulkSessionClient(rg)
+    s = client.open_session()
+
+    # wave 1: asymmetric — process 0 submits 64 ops, process 1 only 16,
+    # so the agreed accumulator width comes from process 0 and process 1
+    # pads with empty dispatch windows.
+    n_ops = 64 if pid == 0 else 16
+    seqs = s.submit_batch(np.arange(n_ops) % 4, ap.OP_LONG_ADD, 1)
+    client.flush()
+    vals = s.results_window(int(seqs[0]), n_ops)
+    # per-group FIFO: results of group g's ops are its running count
+    per_group = n_ops // 4
+    fifo_ok = all(
+        list(vals[np.arange(n_ops) % 4 == g]) == list(
+            range(1, per_group + 1))
+        for g in range(4))
+
+    # wave 2: ENTIRELY empty on process 1 (local n=0 through a
+    # collective drive)
+    if pid == 0:
+        s.submit_batch([0] * 8, ap.OP_LONG_ADD, 1)
+    client.flush()
+
+    # read back through the lockstep query lane: local group 0 sums to
+    # per_group (+8 for process 0's second wave)
+    v0 = rg.serve_query(0, ap.OP_VALUE_GET)
+    expect0 = per_group + (8 if pid == 0 else 0)
+
+    print("RESULT " + json.dumps(
+        {"pid": pid, "fifo_ok": bool(fifo_ok), "v0": v0,
+         "expect0": expect0, "committed": int(n_ops)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

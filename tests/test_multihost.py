@@ -22,21 +22,17 @@ import pytest
 pytest.importorskip("jax")
 
 WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+DEEP_WORKER = os.path.join(os.path.dirname(__file__),
+                           "multihost_deep_worker.py")
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def test_two_process_cluster():
+def _run_workers(worker: str) -> dict:
     coord = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # the worker pins its own platform
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, coord, "2", str(i)],
+            [sys.executable, worker, coord, "2", str(i)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             env=env, text=True)
         for i in range(2)
@@ -52,7 +48,6 @@ def test_two_process_cluster():
                 p.kill()
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out[-4000:]}"
-
     results = {}
     for out in outs:
         for line in out.splitlines():
@@ -60,6 +55,17 @@ def test_two_process_cluster():
                 d = json.loads(line[len("RESULT "):])
                 results[d["pid"]] = d
     assert set(results) == {0, 1}, f"missing worker results: {outs}"
+    return results
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_cluster():
+    results = _run_workers(WORKER)
     for pid, d in results.items():
         # wave 1 deltas g+1 from zero -> g+1; wave 2 +1; partition wave +10
         assert d["r1"] == [g + 1 for g in range(8)], (pid, d)
@@ -69,3 +75,15 @@ def test_two_process_cluster():
         assert d["v1"] == 13, (pid, d)  # group 1: 3 + 10
         assert d["members0"] == [0, 1, 2], (pid, d)
         assert 0 <= d["leader0"] < 3
+
+
+def test_two_process_deep_sessioned_drive():
+    """The unified plane multihost (VERDICT r4 #2): a monotone-tag
+    engine sharded over 2 processes, driven through the SESSIONED bulk
+    client (deep pipelined drive) with asymmetric per-process loads —
+    including one wave where process 1 submits nothing and must pad the
+    collective drive with empty windows."""
+    results = _run_workers(DEEP_WORKER)
+    for pid, d in results.items():
+        assert d["fifo_ok"], (pid, d)
+        assert d["v0"] == d["expect0"], (pid, d)

@@ -1,0 +1,88 @@
+#!/bin/bash
+# Round-5 opportunistic TPU bench hunt (VERDICT.md r4 directive 1).
+# Loop until every pending scenario has a green line appended to
+# BENCH_TPU_r05.jsonl or the deadline passes.  Each bench invocation
+# fail-fasts (rc=2) when the tunnel is dead (require_devices, 1 probe
+# x 45s, so a dead window costs <1 min per attempt).
+#
+# Priority order: the driver path (default counter) FIRST so
+# BENCH_r05.json will parse, then host deep with 5 reps (target: every
+# rep >= 1M), mixed at the new 2-4 timers (predicted p99 ~146 ms),
+# post-batching spi, host_read, single-group WITH a tunnel-RTT probe
+# recorded alongside (settles weather-vs-regression), then fill, then
+# an XLA profile of mixed.
+OUT=/root/repo/BENCH_TPU_r05.jsonl
+DEADLINE=$(( $(date +%s) + ${HUNT_BUDGET_S:-41000} ))
+STATE=/tmp/hunt_done_r05
+touch $STATE
+
+rtt_probe() {
+  # Bounded tunnel-RTT probe: 20 tiny device round-trips, reports
+  # ms stats.  Recorded alongside counter1 so single-group swings can
+  # be attributed to tunnel weather vs regression (VERDICT r4 weak 6).
+  timeout 180 python - <<'PY' 2>>/tmp/hunt_rtt.log
+import json, os, time
+os.environ.setdefault("JAX_PLATFORMS", "tpu")
+from copycat_tpu.utils.platform import require_devices
+require_devices(probes=1, timeout_s=45)
+import jax, jax.numpy as jnp
+x = jax.device_put(jnp.zeros((8,), jnp.int32))
+f = jax.jit(lambda v: v + 1)
+f(x).block_until_ready()  # compile outside the timed loop
+samples = []
+for _ in range(20):
+    t0 = time.perf_counter()
+    f(x).block_until_ready()
+    samples.append((time.perf_counter() - t0) * 1e3)
+samples.sort()
+print(json.dumps({"metric": "tunnel_rtt_ms", "min": round(samples[0], 3),
+                  "median": round(samples[10], 3), "max": round(samples[-1], 3)}))
+PY
+}
+
+run() {
+  name=$1; shift
+  grep -qx "$name" $STATE && return 0
+  echo "=== $(date -u +%H:%M:%S) $name ===" >&2
+  line=$(env "$@" COPYCAT_DEVICE_PROBES=1 COPYCAT_BENCH_DEVICE_TIMEOUT=45 \
+      timeout 1800 python /root/repo/bench.py 2>>/tmp/hunt_${name}.log | tail -1)
+  if [ -n "$line" ] && echo "$line" | python3 -c 'import json,sys; d=json.loads(sys.stdin.read()); assert "metric" in d' 2>/dev/null; then
+    echo "{\"scenario\": \"$name\", \"rc\": 0, \"window\": \"$(date -u +%FT%H:%MZ)\", \"result\": $line}" >> $OUT
+    echo "$name" >> $STATE
+    echo "    $name OK" >&2
+  else
+    echo "    $name failed/dead-tunnel" >&2
+    return 1
+  fi
+}
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  # Driver path first: same invocation the driver makes for BENCH_r05.json.
+  run counter COPYCAT_BENCH_SCENARIO=counter COPYCAT_BENCH_GROUPS=10000 COPYCAT_BENCH_REPEATS=3 || { sleep 240; continue; }
+  run host5 COPYCAT_BENCH_SCENARIO=host COPYCAT_BENCH_GROUPS=10000 COPYCAT_BENCH_HOST_BURST=64 COPYCAT_BENCH_REPEATS=5
+  run session COPYCAT_BENCH_SCENARIO=session COPYCAT_BENCH_GROUPS=10000 COPYCAT_BENCH_HOST_BURST=64 COPYCAT_BENCH_REPEATS=3
+  run mixed COPYCAT_BENCH_SCENARIO=mixed COPYCAT_BENCH_GROUPS=100000 COPYCAT_BENCH_PEERS=5 COPYCAT_BENCH_REPEATS=3
+  run spi COPYCAT_BENCH_SCENARIO=spi COPYCAT_BENCH_SPI_BURSTS=3
+  run host_read COPYCAT_BENCH_SCENARIO=host_read COPYCAT_BENCH_GROUPS=10000 COPYCAT_BENCH_HOST_BURST=64 COPYCAT_BENCH_REPEATS=3
+  if ! grep -qx rtt $STATE; then
+    r=$(rtt_probe | tail -1)
+    if [ -n "$r" ]; then
+      echo "{\"scenario\": \"rtt\", \"rc\": 0, \"window\": \"$(date -u +%FT%H:%MZ)\", \"result\": $r}" >> $OUT
+      echo rtt >> $STATE
+    fi
+  fi
+  run counter1 COPYCAT_BENCH_SCENARIO=counter COPYCAT_BENCH_GROUPS=1 COPYCAT_BENCH_REPEATS=3
+  run lock COPYCAT_BENCH_SCENARIO=lock COPYCAT_BENCH_GROUPS=10000 COPYCAT_BENCH_REPEATS=3
+  run map_read_atomic COPYCAT_BENCH_SCENARIO=map_read COPYCAT_BENCH_GROUPS=10000 COPYCAT_BENCH_READ_LEVEL=atomic COPYCAT_BENCH_REPEATS=3
+  run election COPYCAT_BENCH_SCENARIO=election COPYCAT_BENCH_GROUPS=1000 COPYCAT_BENCH_REPEATS=3
+  run host_read_atomic COPYCAT_BENCH_SCENARIO=host_read COPYCAT_BENCH_GROUPS=10000 COPYCAT_BENCH_HOST_BURST=64 COPYCAT_BENCH_READ_LEVEL=atomic COPYCAT_BENCH_REPEATS=3
+  if [ "$(wc -l < $STATE)" -ge 12 ] && ! grep -qx profile $STATE; then
+    echo "=== $(date -u +%H:%M:%S) profile ===" >&2
+    if bash /root/repo/tpu_profile_mixed.sh /tmp/mixed_trace_r05 >/tmp/hunt_profile.log 2>&1; then
+      echo profile >> $STATE
+      echo "    profile OK (/tmp/hunt_profile.log)" >&2
+    fi
+  fi
+  [ "$(wc -l < $STATE)" -ge 13 ] && { echo "hunt complete" >&2; break; }
+  sleep 120
+done
