@@ -227,6 +227,15 @@ class RaftClient(Managed):
                 response = await asyncio.wait_for(conn.send(request), self.session_timeout)
             except (TransportError, OSError, asyncio.TimeoutError) as e:
                 last = e
+                # A hinted leader that failed the attempt gets no second
+                # pin: _connect prefers the hint, so keeping it after a
+                # timeout re-dialed the SAME stuck server every retry —
+                # under a partitioned-but-dialable old leader the client
+                # never reached the majority side (found by the
+                # partition nemesis, tests/test_nemesis_raft.py).
+                if self._connected_to is not None \
+                        and self._connected_to == self._leader_hint:
+                    self._leader_hint = None
                 self._drop_connection()
                 await asyncio.sleep(backoff)
                 backoff = min(backoff * 2, 0.25)

@@ -195,7 +195,9 @@ async def create_cluster(
             RaftServer(
                 addr,
                 addresses,
-                LocalTransport(registry),
+                # local_address identifies this server's DIALS to the
+                # nemesis (partition membership for peer connections)
+                LocalTransport(registry, local_address=addr),
                 machine_factory(),
                 storage=store,
                 election_timeout=election_timeout,
