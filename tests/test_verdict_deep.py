@@ -1,0 +1,26 @@
+"""Smoke the deep-plane verdict harness (VERDICT r4 #4) at tiny scale.
+
+The committed LINEARIZABILITY.md block comes from the full-scale run
+(``python -m copycat_tpu.testing.verdict``); this guards the harness
+mechanics — fault schedules with mid-drive recovery, per-op real-time
+windows from BulkResult, the abort/recover path, and the checker hookup.
+"""
+
+import pytest
+
+pytest.importorskip("jax")
+
+
+def test_deep_verdict_smoke(monkeypatch):
+    import copycat_tpu.testing.verdict as V
+
+    monkeypatch.setattr(V, "DEEP_GROUPS", 32)
+    monkeypatch.setattr(V, "DEEP_SAMPLE", 8)
+    monkeypatch.setattr(V, "DEEP_EPOCHS", 8)
+    res = V.run_deep_verdict()
+    assert res["violations"] == 0
+    assert res["undecided_groups"] == 0
+    assert res["linearizable"] is True
+    # the harness actually checked real committed work
+    assert res["checked_ops"] >= 8 * 8 * 4 // 2
+    assert res["sampled_groups"] == 8
