@@ -52,8 +52,41 @@ class Atomix(Managed):
     async def exists(self, key: str) -> bool:
         return bool(await self.client.submit(ResourceExists(key)))
 
-    async def get(self, key: str, resource_type: Type[R]) -> R:
-        """Singleton-per-node resource handle (reference ``Atomix.get:205-208``)."""
+    @staticmethod
+    async def _build_facade(instance: InstanceClient, resource_type: type,
+                            factory: Any):
+        """Build (factory or reflective constructor) + validate a facade;
+        closes the just-opened instance session before surfacing a bad
+        factory so it doesn't linger until session timeout."""
+        build = factory if factory is not None else resource_type
+        try:
+            resource = build(instance)
+            if not isinstance(resource, resource_type):
+                raise TypeError(
+                    f"factory built {type(resource).__name__}, not a "
+                    f"{resource_type.__name__}")
+        except BaseException:
+            try:
+                await instance.close()
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+            raise
+        return resource
+
+    async def get(self, key: str, resource_type: Type[R],
+                  factory: Any = None) -> R:
+        """Singleton-per-node resource handle (reference ``Atomix.get:205-208``).
+
+        ``factory`` (reference's ``Atomix.get(key, type, factory)``
+        overload) builds the client-side facade from its
+        ``InstanceClient`` instead of the reflective one-arg constructor
+        — for subclassed/wrapped resources; the replicated state machine
+        still resolves from ``resource_type``. The built object must be
+        a ``resource_type`` instance (the singleton cache's type check
+        stays meaningful). The node-local singleton wins, as in the
+        reference: on a cache hit the EXISTING facade is returned and
+        ``factory`` is not invoked — pass the factory at first get (or
+        use :meth:`create`) when a custom facade matters."""
         cached = self._resources.get(key)
         if cached is not None:
             if not isinstance(cached, resource_type):
@@ -62,9 +95,10 @@ class Atomix(Managed):
             return cached
         machine = resource_state_machine_of(resource_type)
         instance_id = await self.client.submit(GetResource(key, machine))
-        resource = resource_type(InstanceClient(
-            instance_id, self.client,
-            on_delete=lambda: self._evict(key, instance_id)))
+        resource = await self._build_facade(
+            InstanceClient(instance_id, self.client,
+                           on_delete=lambda: self._evict(key, instance_id)),
+            resource_type, factory)
         self._resources[key] = resource
         return resource
 
@@ -77,12 +111,15 @@ class Atomix(Managed):
                                           None) == instance_id:
             del self._resources[key]
 
-    async def create(self, key: str, resource_type: Type[R]) -> R:
+    async def create(self, key: str, resource_type: Type[R],
+                     factory: Any = None) -> R:
         """Fresh instance with its own virtual session per call
-        (reference ``Atomix.create:303-306``)."""
+        (reference ``Atomix.create:303-306``; ``factory`` per the
+        ``create(key, type, factory)`` overload — see :meth:`get`)."""
         machine = resource_state_machine_of(resource_type)
         instance_id = await self.client.submit(CreateResource(key, machine))
-        return resource_type(InstanceClient(instance_id, self.client))
+        return await self._build_facade(
+            InstanceClient(instance_id, self.client), resource_type, factory)
 
     async def _do_open(self) -> None:
         await self.client.open()

@@ -45,7 +45,7 @@ from __future__ import annotations
 import inspect
 import logging
 from collections import deque
-from contextlib import nullcontext
+
 from typing import Any, NamedTuple
 
 from ..resource.state_machine import ResourceStateMachine
@@ -163,6 +163,11 @@ class DeviceWindow:
         self._waiting: dict[int, deque[_Job]] = {}  # group -> queued jobs
         self._order: list[_Job] = []                # finalization order
         self._finalized = 0
+        #: device ops yielded but not yet submitted: (job, op, a, b, c).
+        #: Submission is deferred so one vectorized ``submit_batch`` per
+        #: pump cycle replaces a per-op ``submit`` (the per-op deque +
+        #: dict staging was a top line of the SPI burst profile).
+        self._staged: list = []
         #: per-entry context inherited by timer-spawned jobs (the applying
         #: server sets it around each command entry's tick+execute)
         self.job_ctx: Any = None
@@ -204,11 +209,13 @@ class DeviceWindow:
         or finishes; iteratively promote waiting jobs of freed groups (a
         long chain of no-op jobs must not recurse)."""
         work: list[tuple[_Job, Any]] = [(job, value)]
-        groups = None
         while work:
             j, val = work.pop()
             try:
-                with j.ctx if j.ctx is not None else nullcontext():
+                if j.ctx is not None:
+                    with j.ctx:
+                        yielded = j.gen.send(val)
+                else:
                     yielded = j.gen.send(val)
             except StopIteration as stop:
                 j.done = True
@@ -218,10 +225,11 @@ class DeviceWindow:
                 j.exc = e
             if not j.done:
                 if yielded[0] == "cmd":
-                    if groups is None:
-                        groups = self._eng._ensure()
-                    j.tag = groups.submit(j.group, yielded[1], yielded[2],
-                                          yielded[3], yielded[4])
+                    # defer the engine submit: _flush_staged turns every
+                    # op staged this cycle into ONE vectorized
+                    # submit_batch call (tags assigned there)
+                    self._staged.append((j, yielded[1], yielded[2],
+                                         yielded[3], yielded[4]))
                     j.resume_round = None
                     continue
                 # unknown yield: fail THIS job (still freeing its group
@@ -263,11 +271,30 @@ class DeviceWindow:
                 self._advance(j, j.pending)
         return progressed
 
+    def _flush_staged(self, groups) -> None:
+        """Submit every staged device op in ONE vectorized call (tags
+        assigned here); per-group FIFO holds because submit_batch's
+        stable group sort preserves staging order within a group."""
+        staged, self._staged = self._staged, []
+        if not staged:
+            return
+        if len(staged) == 1:
+            j, op, a, b, c = staged[0]
+            j.tag = groups.submit(j.group, op, a, b, c)
+            return
+        tags = groups.submit_batch(
+            [s[0].group for s in staged], [s[1] for s in staged],
+            [s[2] for s in staged], [s[3] for s in staged],
+            [s[4] for s in staged])
+        for s, t in zip(staged, tags.tolist()):
+            s[0].tag = t
+
     def pump(self) -> None:
         """Drive every pending job to completion, then run finalizations
         in add order."""
         if self._active:
             groups = self._eng._ensure()
+            self._flush_staged(groups)
             start = groups.rounds
             while self._active:
                 if groups.rounds - start > self.MAX_ROUNDS:
@@ -278,6 +305,7 @@ class DeviceWindow:
                     # a no-progress watchdog, not a total budget: a long
                     # FIFO chain on one group is legitimate work
                     start = groups.rounds
+                    self._flush_staged(groups)
                 elif self._active:
                     groups.step_round()
         self._try_finalize()
@@ -1620,11 +1648,19 @@ class DeviceLeaderElectionState(DeviceBackedStateMachine):
 # registry + lazy opcode access
 # ---------------------------------------------------------------------------
 
+_ops_mod = None
+
+
 def ops():
     """The device opcode/event-code module, imported lazily so constructing
-    a pure-CPU cluster never imports JAX."""
-    from ..ops import apply as _apply
-    return _apply
+    a pure-CPU cluster never imports JAX. Memoized: the import-machinery
+    lookup (sys.modules + parent resolution) was the single hottest line
+    of the SPI burst profile when paid per op."""
+    global _ops_mod
+    if _ops_mod is None:
+        from ..ops import apply as _ops_mod_local
+        _ops_mod = _ops_mod_local
+    return _ops_mod
 
 
 def FAIL() -> int:

@@ -253,3 +253,41 @@ async def test_wrong_type_for_existing_key_fails():
             await client2.get("typed", EchoResource)
     finally:
         await _teardown([client, client2] + servers)
+
+
+@async_test(timeout=90)
+async def test_factory_overloads_build_custom_facades():
+    """Reference ``Atomix.get(key, type, factory)`` /
+    ``create(key, type, factory)`` (``Atomix.java:205-208,303-306``): the
+    factory builds the client-side facade from its InstanceClient; the
+    replicated state machine still resolves from the resource type."""
+
+    class TracingValue(ValueResource):
+        def __init__(self, client):
+            super().__init__(client)
+            self.calls = 0
+
+        async def set(self, value):
+            self.calls += 1
+            return await super().set(value)
+
+    servers, addrs, registry = await _servers(3)
+    client = AtomixClient(addrs, LocalTransport(registry), session_timeout=3.0)
+    await client.open()
+    try:
+        r = await client.get("fac", ValueResource, factory=TracingValue)
+        assert isinstance(r, TracingValue)
+        await r.set("x")
+        assert r.calls == 1
+        # singleton cache returns the SAME factory-built facade
+        assert await client.get("fac", ValueResource) is r
+        # create(): fresh session, same replicated state, factory applies
+        r2 = await client.create("fac", ValueResource, factory=TracingValue)
+        assert isinstance(r2, TracingValue) and r2 is not r
+        assert await r2.get() == "x"
+        # a factory whose product is not a resource_type is rejected
+        with pytest.raises(TypeError, match="factory built"):
+            await client.create("fac2", ValueResource,
+                                factory=lambda c: object())
+    finally:
+        await _teardown([client] + servers)
