@@ -55,9 +55,13 @@ class Atomix(Managed):
     @staticmethod
     async def _build_facade(instance: InstanceClient, resource_type: type,
                             factory: Any):
-        """Build (factory or reflective constructor) + validate a facade;
-        closes the just-opened instance session before surfacing a bad
-        factory so it doesn't linger until session timeout."""
+        """Build (factory or reflective constructor) + validate a facade.
+
+        On a bad factory the LOCAL instance state is closed (listener
+        wrappers); the server-side virtual session is reclaimed when the
+        parent client session closes or times out — the same fate as any
+        abandoned instance in the reference (there is deliberately no
+        instance-close catalog op; see manager/operations.py)."""
         build = factory if factory is not None else resource_type
         try:
             resource = build(instance)

@@ -307,7 +307,22 @@ class DeviceWindow:
                     start = groups.rounds
                     self._flush_staged(groups)
                 elif self._active:
-                    groups.step_round()
+                    # When every active job is sitting out a KNOWN settle
+                    # window (event consumers after their op committed),
+                    # fuse exactly that many rounds into one compiled
+                    # program + fetch — one tunnel round-trip instead of
+                    # min(waits). A fresh submit needs no fusion: the
+                    # step commits and reports in-round under full
+                    # delivery (commit latency 1), so the loaded round
+                    # resolves it.
+                    waits = [j.resume_round - groups.rounds
+                             for j in self._active.values()
+                             if j.resume_round is not None]
+                    if (len(waits) == len(self._active)
+                            and min(waits) > 1):
+                        groups.step_rounds(min(waits))
+                    else:
+                        groups.step_round()
         self._try_finalize()
 
     barrier = pump  # drain point before entries that read manager state

@@ -46,6 +46,32 @@ def _jitted_programs(config: Config):
             jax.jit(partial(install_snapshots, config=config)))
 
 
+@lru_cache(maxsize=None)
+def _fused_rounds_program(config: Config, n: int):
+    """``n`` consensus rounds in ONE compiled program: round 0 carries
+    the caller's submits, rounds 1..n-1 run empty (the commit pipeline —
+    replicate, commit, report — advancing). Returns the new state, round
+    0's outputs, and the stacked outputs of the remaining rounds. One
+    dispatch + one fetch instead of ``n``: through a tunneled
+    accelerator that is the difference between ~n round-trips and one
+    per SPI window pump cycle (the round-5 spi floor)."""
+    import jax.numpy as jnp
+
+    def fused(state, submits, deliver, key):
+        keys = jax.random.split(key, n)
+        state, out0 = step(state, submits, deliver, keys[0], config=config)
+        empty = jax.tree.map(jnp.zeros_like, submits)
+
+        def body(st, kk):
+            st, out = step(st, empty, deliver, kk, config=config)
+            return st, out
+
+        state, outs = jax.lax.scan(body, state, keys[1:])
+        return state, out0, outs
+
+    return jax.jit(fused)
+
+
 class RaftGroups:
     """G Raft groups × P peers, stepped as one compiled program."""
 
@@ -155,6 +181,11 @@ class RaftGroups:
         # gate tracks the same value as the max live-ring tag)
         if self.config.monotone_tag_accept:
             self._stream_count = np.zeros(num_groups, np.int64)
+        # direct-staged submit buffer (submit_batch fast lane): rows
+        # scattered straight into the next round's Submits, bypassing
+        # the per-group deque fan-out + re-drain (two Python loops that
+        # dominated the SPI window's loaded round at 1k ops)
+        self._staged_sub: Submits | None = None
 
     @property
     def sessions(self):
@@ -298,11 +329,52 @@ class RaftGroups:
         return placed
 
     def _build_submits(self) -> Submits:
+        if self._staged_sub is not None:
+            # consume the direct-staged buffer. Queue entries that
+            # appeared AFTER staging (post-step requeues, stray
+            # submit()s) wait one round — per-group FIFO holds because
+            # staging refuses while queues are non-empty, so anything
+            # queued is strictly newer than everything staged.
+            sub = self._staged_sub
+            self._staged_sub = None
+            return sub
         sub = self._empty_submits()
         if self._queues:
             self._drain_into(self._queues, sub,
                              skip=self._held or None)
         return sub
+
+    def _stage_direct(self, g: np.ndarray, op, a, b, c,
+                      tags: np.ndarray) -> bool:
+        """Scatter rows straight into the next round's submit buffer
+        (pure numpy, no per-op Python). Refused (``False`` — caller
+        takes the deque path) whenever ordering could be observable:
+        queued ops exist (FIFO vs them), holds are active, the engine is
+        monotone (deep plane owns its streams), or a group would
+        overflow its submit window."""
+        if (self._queues or self._held or self._staged_sub is not None
+                or self.config.monotone_tag_accept):
+            return False
+        counts = np.bincount(g, minlength=self.num_groups)
+        if counts.max(initial=0) > self.submit_slots:
+            return False
+        order = np.argsort(g, kind="stable")
+        gs = g[order]
+        n = gs.size
+        first = np.ones(n, bool)
+        first[1:] = gs[1:] != gs[:-1]
+        starts = np.flatnonzero(first)
+        cnt = np.diff(np.append(starts, n))
+        slots = np.arange(n) - np.repeat(starts, cnt)
+        sub = self._empty_submits()
+        sub.opcode[gs, slots] = op[order]
+        sub.a[gs, slots] = a[order]
+        sub.b[gs, slots] = b[order]
+        sub.c[gs, slots] = c[order]
+        sub.tag[gs, slots] = tags[order]
+        sub.valid[gs, slots] = True
+        self._staged_sub = sub
+        return True
 
     # -- stepping ----------------------------------------------------------
 
@@ -423,6 +495,65 @@ class RaftGroups:
         if self._sessions is not None:
             self._sessions.tick()
         return out
+
+    def step_rounds(self, n: int) -> None:
+        """Advance ``n`` rounds with ONE device dispatch + ONE fetch.
+
+        Semantically equivalent to ``n`` ``step_round()`` calls whose
+        rounds 1..n-1 found empty submit queues: round 0 drains the
+        queues as usual; later rounds advance the commit pipeline
+        (replicate → commit → report) of whatever round 0 accepted.
+        Queued ops beyond round 0's submit window simply ride the next
+        call (the caller's drive loop keeps calling until resolved).
+        The SPI device window uses this for its pump cycles — on a
+        tunneled accelerator it collapses the per-cycle cost from ~n
+        blocking round-trips to one.
+
+        Falls back to per-round stepping for n <= 1 and for engines with
+        overridden staging hooks (multihost lockstep drives per-round
+        decisions). Deliver masks need no fallback: nemesis faults are
+        installed via ``self.deliver`` and the fused program reads the
+        same mask every round, exactly like n sequential step_round
+        calls with an unchanged mask.
+        """
+        if n <= 1 or type(self)._stage_submits is not RaftGroups._stage_submits:
+            for _ in range(n):
+                self.step_round()
+            return
+        submits = self._build_submits()
+        self._key, key = jax.random.split(self._key)
+        fused = _fused_rounds_program(self.config, n)
+        with self.metrics.timer("step_wall_ms"):
+            self.state, raw0, raws = fused(self.state, submits,
+                                           self.deliver, key)
+            raws = jax.block_until_ready(raws)
+        # overlap BOTH transfers (round 0 + the stacked tail) before the
+        # first blocking conversion — one round-trip for the whole fetch
+        for leaf in jax.tree.leaves(raws):
+            leaf.copy_to_host_async()
+        out0 = self._fetch_outputs(raw0)
+        outs = jax.tree.map(np.asarray, raws)
+        self.rounds += 1
+        self.metrics.counter("rounds").inc()
+        self._requeue_rejected(submits, out0)
+        self._harvest(out0)
+        self._record_assigned(submits, out0)
+        if self._sessions is not None:
+            self._sessions.tick()
+        for i in range(n - 1):
+            out_i = jax.tree.map(lambda x, i=i: x[i], outs)
+            self.rounds += 1
+            self.metrics.counter("rounds").inc()
+            self._harvest(out_i)
+            if self._sessions is not None:
+                self._sessions.tick()
+        if self._any_across(bool(self._query_queues)):
+            self._serve_queries()
+        # snapshot-install decision from the LAST round's view (deferring
+        # a mid-scan stale follower one cycle is the same recovery path)
+        if bool(outs.stale[-1].any()):
+            last = jax.tree.map(lambda x: x[-1], raws)
+            self.state = self._install(self.state, last.stale, last.leader)
 
     def serve_query(self, group: int, opcode: int, a: int = 0, b: int = 0,
                     c: int = 0, max_attempts: int = 50,
@@ -701,9 +832,9 @@ class RaftGroups:
         groups_a = np.asarray(groups, np.int64).ravel()
         n = groups_a.size
         bc = lambda x: np.broadcast_to(
-            np.asarray(x, np.int64).ravel(), (n,)).tolist()
-        op_l, a_l, b_l, c_l = bc(opcode), bc(a), bc(b), bc(c)
-        if any(o in (OP_CFG_ADD, OP_CFG_REMOVE) for o in set(op_l)):
+            np.asarray(x, np.int64).ravel(), (n,))
+        op_a, a_a, b_a, c_a = bc(opcode), bc(a), bc(b), bc(c)
+        if np.isin(op_a, (OP_CFG_ADD, OP_CFG_REMOVE)).any():
             raise ValueError("membership changes go through "
                              "add_peer/remove_peer, not submit_batch")
         self._refuse_monotone()
@@ -715,15 +846,18 @@ class RaftGroups:
         g_l = groups_a.tolist()
         rnd = self.rounds
         self._inflight.update(zip(tag_l, ((g, rnd) for g in g_l)))
+        op_l, a_l, b_l, c_l = (op_a.tolist(), a_a.tolist(),
+                               b_a.tolist(), c_a.tolist())
         self._inflight_ops.update(
             zip(tag_l, zip(op_l, a_l, b_l, c_l)))
-        order = np.argsort(groups_a, kind="stable")
-        bounds = np.flatnonzero(np.diff(groups_a[order])) + 1
-        for seg in np.split(order, bounds):
-            seg_l = seg.tolist()
-            q = self._queues.setdefault(g_l[seg_l[0]], deque())
-            q.extend((op_l[i], a_l[i], b_l[i], c_l[i], tag_l[i])
-                     for i in seg_l)
+        if not self._stage_direct(groups_a, op_a, a_a, b_a, c_a, tags):
+            order = np.argsort(groups_a, kind="stable")
+            bounds = np.flatnonzero(np.diff(groups_a[order])) + 1
+            for seg in np.split(order, bounds):
+                seg_l = seg.tolist()
+                q = self._queues.setdefault(g_l[seg_l[0]], deque())
+                q.extend((op_l[i], a_l[i], b_l[i], c_l[i], tag_l[i])
+                         for i in seg_l)
         self.metrics.counter("ops_submitted").inc(n)
         return tags
 

@@ -34,7 +34,7 @@ async def main() -> None:
     servers = [
         AtomixServer(a, addrs, LocalTransport(registry),
                      election_timeout=0.2, heartbeat_interval=0.04,
-                     session_timeout=10.0, executor="tpu",
+                     session_timeout=60.0, executor="tpu",
                      engine_config=DeviceEngineConfig(
                          capacity=max(16, n + 4), num_peers=3,
                          log_slots=32))
@@ -42,7 +42,7 @@ async def main() -> None:
     ]
     await asyncio.gather(*(s.open() for s in servers))
     client = AtomixClient(addrs, LocalTransport(registry),
-                          session_timeout=10.0)
+                          session_timeout=60.0)
     await client.open()
     print(f"3-server cluster up; device engine hosts the resources")
 
