@@ -280,3 +280,13 @@ async def test_spi_linearizable_under_partition_and_loss_cpu():
 async def test_spi_lock_histories_linearizable_under_partition():
     _check(*await _run_stack("cpu", _lock_loop, fault="partition"),
            model=LockModel)
+
+
+@async_test(timeout=420)
+async def test_spi_linearizable_under_leader_partition_tpu():
+    """Partition nemesis against the DEVICE-executor stack: the engines
+    replicate deterministically from each server's committed CPU log, so
+    a partitioned server's engine simply lags and reconverges by replay
+    — the history must stay linearizable through it."""
+    _check(*await _run_stack("tpu", _register_loop, fault="partition"),
+           model=RegisterModel)
