@@ -416,22 +416,61 @@ class BulkDriver:
         out[order] = results
         return out
 
-    def recover(self, settle_rounds: int = 30) -> None:
+    def recover(self, settle_rounds: int = 30,
+                max_rounds: int = 500) -> None:
         """Re-arm the deep plane after an abandoned drive (TimeoutError).
 
-        The abandon-time cursor resync reads the max live-ring tag of the
-        MOST-ADVANCED lane — but an entry replicated only to a minority
-        lineage can commit later (its leader re-wins), and its tag would
-        then alias a fresh op's accumulator slot in the next drive
-        (mis-correlating results). Call this after healing faults: it
-        steps ``settle_rounds`` so every surviving lineage either commits
-        or is rewound away, then resyncs the cursor past everything that
-        committed — making post-abandon tag reuse impossible.
+        Call AFTER healing faults. Two hazards bracket the tag cursor:
+
+        - too LOW: an entry replicated to a minority lineage can still
+          commit (its leader re-wins) — reusing its tag would alias a
+          fresh op's accumulator slot (mis-correlated results);
+        - too HIGH: an isolated leader may have ACCEPTED a burst into
+          its ring (acceptance is lane-local) that a post-heal election
+          ERASES by rewind — the abandon-time conservative resync
+          (max of host/device views) then leaves the cursor pointing
+          past a ring that reverted, and every later drive is
+          gate-rejected forever (found by the round-5 abandoned-flush
+          test).
+
+        So: settle, then wait until every group's lanes CONVERGE (same
+        last/applied index, a leader present — no surviving divergent
+        lineage), then trust the device outright (plain assignment).
+        On dynamic-membership engines removed lanes never converge, so
+        the check is skipped and the conservative max-resync kept — a
+        churned group that hit the too-high hazard needs its membership
+        restored before recovery (documented limitation; the deep plane
+        runs static membership in-tree).
         """
         rg = self._rg
         for _ in range(settle_rounds):
             rg.step_round()
-        self._resync_stream_count()
+        if rg.config.dynamic_membership:
+            self._resync_stream_count()
+            return
+        # Convergence polls are lockstep-agreed (step_round is a
+        # collective program on multihost engines — a process-local
+        # break would deadlock peers) and spaced POLL_EVERY rounds apart
+        # so a tunneled accelerator pays one blocking fetch per few
+        # rounds, not per round.
+        POLL_EVERY = 4
+        for attempt in range(max_rounds):
+            last, applied, role = (np.asarray(x) for x in rg._fetch_acc(
+                (rg.state.last_index, rg.state.applied_index,
+                 rg.state.role)))
+            mine = bool((last.min(1) == last.max(1)).all()
+                        and (applied.min(1) == applied.max(1)).all()
+                        and ((role == 2).sum(1) >= 1).all())
+            if rg._agree(mine):
+                break
+            for _ in range(POLL_EVERY):
+                rg.step_round()
+        else:
+            raise TimeoutError(
+                "recover: cluster did not converge — heal every fault "
+                "before calling recover()")
+        rg._stream_count = stream_count_from_state(rg.state,
+                                                   fetch=rg._fetch_acc)
 
     def _resync_stream_count(self) -> None:
         """Set each group's stream cursor to the max live-ring tag on the

@@ -370,6 +370,15 @@ class BulkSessionClient:
                     if new_cursor != cursor:
                         s._subs[group] = (listeners, new_cursor)
 
+    def recover(self, settle_rounds: int = 30) -> None:
+        """Re-arm after an abandoned flush (``TimeoutError``): heal-time
+        protocol delegating to :meth:`BulkDriver.recover` — settle every
+        surviving lineage and resync the tag cursors so post-abandon tag
+        reuse is impossible. Call after restoring delivery (faults
+        healed); then flush as normal. Abandoned commands stay
+        indeterminate (read the state to learn their fate)."""
+        self._driver.recover(settle_rounds=settle_rounds)
+
     def close(self) -> None:
         """Close every session and commit their cleanup."""
         for s in list(self._sessions.values()):

@@ -169,3 +169,44 @@ def test_throughput_smoke(client):
     assert s.results_window(int(seqs[0]), 4096).min() >= 1
     # one pipelined drive: rounds grow like burst/S + settle, not per-op
     assert client._rg.rounds - rounds_before < 4096 // 2
+
+
+def test_abandoned_flush_indeterminate_then_recover():
+    """A flush abandoned mid-fault (liveness lost) marks its commands
+    INDETERMINATE — they may or may not have applied — re-stages the
+    idempotent cleanup ops, and after heal + recover() the client
+    resumes with exactly-once preserved (each abandoned op applied at
+    most once, verified by reading the counter)."""
+    import jax.numpy as jnp
+
+    from copycat_tpu.models.session_client import CommandIndeterminateError
+
+    rg = RaftGroups(4, 3, log_slots=32, submit_slots=4, seed=21,
+                    config=Config(monotone_tag_accept=True))
+    rg.wait_for_leaders()
+    client = BulkSessionClient(rg)
+    s = client.open_session()
+    base = s.submit(0, ap.OP_LONG_ADD, 1)
+    client.flush()
+    assert s.result(base) == 1
+
+    # cut ALL delivery: nothing can commit; the drive must lose liveness
+    rg.deliver = jnp.zeros((4, 3, 3), dtype=bool)
+    seqs = s.submit_batch([0] * 4, ap.OP_LONG_ADD, 1)
+    with pytest.raises(TimeoutError):
+        client.flush(max_rounds=40)
+    with pytest.raises(CommandIndeterminateError):
+        s.result(int(seqs[0]))
+
+    # heal + recover, then the session keeps working with fresh seqs
+    rg.deliver = jnp.ones((4, 3, 3), dtype=bool)
+    client.recover()
+    q = s.submit(0, ap.OP_VALUE_GET)
+    client.flush()
+    val = s.result(q)
+    # exactly-once bound: the 4 abandoned adds applied AT MOST once each
+    assert 1 <= val <= 5, val
+    # and new commands still apply exactly once
+    t = s.submit(0, ap.OP_LONG_ADD, 10)
+    client.flush()
+    assert s.result(t) == val + 10
