@@ -22,6 +22,7 @@ CASES = [
     ("custom_resource.py", [], "stock after release: 10"),
     ("bulk_counters.py", ["64", "8"], "linearizable reads/sec"),
     ("device_batch.py", [], "done"),
+    ("session_client.py", ["32", "8"], "lock handed over to backup"),
 ]
 
 
