@@ -408,9 +408,9 @@ def run_host() -> dict:
     from .models import BulkDriver, RaftGroups
 
     mode = os.environ.get("COPYCAT_BENCH_HOST_MODE", "deep")
-    if mode not in ("deep", "bulk", "queued"):
+    if mode not in ("deep", "deepscan", "bulk", "queued"):
         raise SystemExit(
-            f"COPYCAT_BENCH_HOST_MODE={mode!r}: deep|bulk|queued")
+            f"COPYCAT_BENCH_HOST_MODE={mode!r}: deep|deepscan|bulk|queued")
     rg = RaftGroups(GROUPS, PEERS, log_slots=LOG_SLOTS,
                     submit_slots=SUBMIT_SLOTS,
                     config=Config(use_pallas=use_pallas(),
@@ -418,7 +418,8 @@ def run_host() -> dict:
                                   applies_per_round=max(4, SUBMIT_SLOTS),
                                   pool_budgets=POOL_BUDGETS,
                                   resource=RESOURCE_CONFIGS["counter"],
-                                  monotone_tag_accept=(mode == "deep")))
+                                  monotone_tag_accept=(
+                                      mode in ("deep", "deepscan"))))
     per_group = int(os.environ.get(
         "COPYCAT_BENCH_HOST_BURST",
         str(SUBMIT_SLOTS * (8 if mode != "queued" else 1))))
@@ -426,7 +427,7 @@ def run_host() -> dict:
         f"ops/group/burst; device={jax.devices()[0].platform}")
     rg.wait_for_leaders()
     groups = np.repeat(np.arange(GROUPS), per_group)
-    driver = BulkDriver(rg)
+    driver = BulkDriver(rg, deep_scan=(mode == "deepscan"))
 
     lat_p50 = lat_p99 = 0.0
 
@@ -453,7 +454,7 @@ def run_host() -> dict:
             f"ops/sec host-observed")
     out = {
         "metric": (f"host_observed_committed_ops_per_sec_{GROUPS}_groups"
-                   + {"deep": "", "bulk": "_sync",
+                   + {"deep": "", "deepscan": "_scan", "bulk": "_sync",
                       "queued": "_queued"}[mode]),
         "value": round(best, 1),
         "unit": "ops/sec",

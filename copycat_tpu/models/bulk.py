@@ -49,7 +49,7 @@ from typing import Any
 import jax
 import numpy as np
 
-from ..ops.consensus import Submits, deep_step
+from ..ops.consensus import Submits, deep_scan, deep_step
 
 
 def _scatter(G: int, S: int, gi, slots, vals) -> np.ndarray:
@@ -98,6 +98,15 @@ def _window_rank(mask: np.ndarray, starts: np.ndarray, counts: np.ndarray,
 
 
 @lru_cache(maxsize=None)
+def _deep_scan_program(config, onehot: bool = False, donate: bool = False):
+    """Jitted :func:`deep_scan` (whole blind phase as one program; W
+    specializes by shape). Donation hands the state + accumulators back
+    for in-place reuse on accelerators."""
+    return jax.jit(partial(deep_scan, config=config, onehot=onehot),
+                   donate_argnums=(0, 1, 2, 3, 4) if donate else ())
+
+
+@lru_cache(maxsize=None)
 def _deep_program(config, onehot: bool = False, donate: bool = False):
     """Jitted deep_step shared across drivers with the same static Config.
 
@@ -141,7 +150,8 @@ class BulkResult:
 class BulkDriver:
     """Vectorized pipelined driver over one :class:`RaftGroups` batch."""
 
-    def __init__(self, rg, *, allow_sessions: bool = False) -> None:
+    def __init__(self, rg, *, allow_sessions: bool = False,
+                 deep_scan: bool = False) -> None:
         # The CLASSIC drive feeds host numpy straight into the step and
         # fetches whole outputs, bypassing the multihost staging/lockstep
         # hooks step_round routes through — single-host engines only.
@@ -166,6 +176,14 @@ class BulkDriver:
             raise NotImplementedError(
                 "BulkDriver does not pump device sessions; drive session "
                 "engines through models.session_client.BulkSessionClient")
+        # deep_scan: run the whole blind phase as ONE lax.scan program
+        # (one dispatch + one stacked payload upload per drive) instead
+        # of one dispatch per window. Single-host only: the stacked
+        # staging is not wired through the multihost hooks.
+        if deep_scan and (not deep or getattr(rg, "process_count", 1) > 1):
+            raise NotImplementedError(
+                "deep_scan needs a single-host monotone-tag engine")
+        self._scan = deep_scan
         self._rg = rg
 
     def drive(self, groups, opcode, a=0, b=0, c=0,
@@ -634,26 +652,76 @@ class BulkDriver:
                 # stashed per-round event leaves and ingest with seq
                 # dedup. Local-only decision — the fetch reads only this
                 # process's shards, no collective program is launched.
-                for leaves in (rg._fetch_acc(st) for st in ev_stash):
-                    rg._ingest_events(_EventView(*leaves))
+                # Scan-mode stashes are stacked [W, ...]; unroll them.
+                for st in ev_stash:
+                    leaves = rg._fetch_acc(st)
+                    if leaves[0].ndim == 3:
+                        for w in range(leaves[0].shape[0]):
+                            rg._ingest_events(
+                                _EventView(*(x[w] for x in leaves)))
+                    else:
+                        rg._ingest_events(_EventView(*leaves))
                 evflag = rg._stage_acc(np.zeros(G, bool))
             ev_stash.clear()
 
         # phase 1: blind pipelined dispatch — NO device fetch at all. The
         # device runs ~windows rounds deep while the host only stages
-        # tag bases [G,1] and valid masks [G,S].
+        # tag bases [G,1] and valid masks [G,S]. Scan mode goes further:
+        # the whole phase (windows + settle) is ONE stacked payload and
+        # ONE compiled lax.scan dispatch.
         windows = int(np.ceil(B / S))
         tagl = np.zeros((G, 1), np.int32)
-        for w in range(windows):
-            in_w = (rank >= w * S) & (rank < (w + 1) * S)
-            pos = np.flatnonzero(in_w)
-            tagl[seg_groups, 0] = (seg_base + w * S + 1).astype(np.int32)
-            vnp = np.zeros((G, S), bool)
-            vnp[seg_groups] = (w * S + np.arange(S))[None, :] \
-                < counts[:, None]
-            dispatch(tagl.copy(), vnp, payload_leaves(pos, rank[pos] - w * S))
-        for _ in range(3):  # settle: replicate + commit + report lag
-            dispatch(*_idle[:2], _idle[2])
+        if self._scan:
+            W_total = windows + 3      # + replicate/commit/report settle
+            tagl_w = np.zeros((W_total, G, 1), np.int32)
+            valid_w = np.zeros((W_total, G, S), bool)
+
+            def _payload_w(c, v):
+                arr = np.zeros((W_total, G, S), np.int32)
+                if c is not None:
+                    arr[:windows] = c     # burst-uniform: one fill
+                return arr
+
+            op_w, a_w, b_w, c_w = (
+                _payload_w(c, v) for c, v in zip(consts, vals))
+            win_of = rank // S
+            slot_of = rank - win_of * S
+            for w in range(windows):
+                tagl_w[w, seg_groups, 0] = (seg_base + w * S + 1) \
+                    .astype(np.int32)
+                valid_w[w][seg_groups] = (w * S + np.arange(S))[None, :] \
+                    < counts[:, None]
+            if consts[0] is None:
+                op_w[win_of, g_s, slot_of] = op_s
+            if consts[1] is None:
+                a_w[win_of, g_s, slot_of] = a_s
+            if consts[2] is None:
+                b_w[win_of, g_s, slot_of] = b_s
+            if consts[3] is None:
+                c_w[win_of, g_s, slot_of] = c_s
+            _scan = _deep_scan_program(
+                rg.config, onehot=rg.mesh is not None,
+                donate=jax.default_backend() != "cpu")
+            rg._key, key = jax.random.split(rg._key)
+            (rg.state, resbuf, valbuf, rndbuf, evflag, evs) = _scan(
+                rg.state, resbuf, valbuf, rndbuf, evflag, base_dev,
+                Submits(opcode=op_w, a=a_w, b=b_w, c=c_w, tag=tagl_w,
+                        valid=valid_w), deliver, key)
+            r = W_total
+            ev_stash.append(evs)   # stacked [W, ...] leaves
+        else:
+            for w in range(windows):
+                in_w = (rank >= w * S) & (rank < (w + 1) * S)
+                pos = np.flatnonzero(in_w)
+                tagl[seg_groups, 0] = (seg_base + w * S + 1) \
+                    .astype(np.int32)
+                vnp = np.zeros((G, S), bool)
+                vnp[seg_groups] = (w * S + np.arange(S))[None, :] \
+                    < counts[:, None]
+                dispatch(tagl.copy(), vnp,
+                         payload_leaves(pos, rank[pos] - w * S))
+            for _ in range(3):  # settle: replicate + commit + report lag
+                dispatch(*_idle[:2], _idle[2])
         harvest()
 
         # phase 2: straggler suffixes (lease-cold leaders, backpressure).

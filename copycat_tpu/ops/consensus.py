@@ -1021,6 +1021,14 @@ def deep_step(state: RaftState, resbuf: jnp.ndarray, valbuf: jnp.ndarray,
     state, out = step(state, submits, deliver, key, config=config)
     G = out.out_tag.shape[0]
     B = resbuf.shape[1]
+    return _deep_accumulate(state, resbuf, valbuf, rndbuf, evflag, base,
+                            rnd, out, G, B, onehot)
+
+
+def _deep_accumulate(state, resbuf, valbuf, rndbuf, evflag, base, rnd,
+                     out, G, B, onehot):
+    """Scatter one round's applied results into the deep accumulators
+    (the body shared by :func:`deep_step` and :func:`deep_scan`)."""
     k = out.out_tag - 1 - base[:, None]
     ok = out.out_valid & (k >= 0) & (k < B)
     rnd_i = jnp.asarray(rnd, jnp.int32)
@@ -1057,3 +1065,40 @@ def deep_step(state: RaftState, resbuf: jnp.ndarray, valbuf: jnp.ndarray,
     # program on a group-sharded mesh (census-verified)
     evflag = evflag | out.ev_valid.any(axis=1)
     return state, resbuf, valbuf, rndbuf, evflag, out
+
+
+def deep_scan(state: RaftState, resbuf: jnp.ndarray, valbuf: jnp.ndarray,
+              rndbuf: jnp.ndarray, evflag: jnp.ndarray, base: jnp.ndarray,
+              submits_w: Submits, deliver: jnp.ndarray, key: jax.Array,
+              config: Config, onehot: bool = False):
+    """The deep drive's ENTIRE blind phase as one compiled program.
+
+    ``submits_w`` stacks W rounds of submit windows ([W, ...] leaves —
+    the trailing windows are the empty settle rounds); a ``lax.scan``
+    runs :func:`deep_step`'s round W times with the accumulators
+    carried on device. The host uploads one stacked payload and
+    dispatches ONCE instead of once per window — the per-drive
+    host↔device interaction count drops from ~W to 1, on top of the
+    round-4 design's zero blocking fetches (``models/bulk.py`` scan
+    mode; events come back stacked [W, ...] for the rare
+    session-event path).
+    """
+    W = submits_w.valid.shape[0]
+    keys = jax.random.split(key, W)
+    rnds = jnp.arange(W, dtype=jnp.int32)
+
+    def body(carry, xs):
+        st, rb, vb, nb, ev = carry
+        sub, rnd, k = xs
+        st, out = step(st, sub, deliver, k, config=config)
+        st, rb, vb, nb, ev, out = _deep_accumulate(
+            st, rb, vb, nb, ev, base, rnd, out,
+            out.out_tag.shape[0], rb.shape[1], onehot)
+        return (st, rb, vb, nb, ev), (out.ev_seq, out.ev_code,
+                                      out.ev_target, out.ev_arg,
+                                      out.ev_valid)
+
+    (state, resbuf, valbuf, rndbuf, evflag), evs = jax.lax.scan(
+        body, (state, resbuf, valbuf, rndbuf, evflag),
+        (submits_w, rnds, keys))
+    return state, resbuf, valbuf, rndbuf, evflag, evs

@@ -92,3 +92,42 @@ def test_bulk_query_drive_on_classic_engine(rg):
     # share the engine); reads must be served and consistent per group
     assert (got.reshape(8, 3) == got.reshape(8, 3)[:, :1]).all()
     assert (got >= 5).all()
+
+
+def test_deep_scan_mode_matches_dispatch_mode():
+    """``BulkDriver(deep_scan=True)`` — the whole blind phase as ONE
+    lax.scan program — produces identical results, stream cursors, and
+    session events to the per-window dispatch mode (same seeds)."""
+    from copycat_tpu.ops.consensus import Config
+
+    def build():
+        rg = RaftGroups(8, 3, log_slots=32, submit_slots=4, seed=9,
+                        config=Config(monotone_tag_accept=True))
+        rg.wait_for_leaders()
+        return rg
+
+    rg1, rg2 = build(), build()
+    d1 = BulkDriver(rg1)
+    d2 = BulkDriver(rg2, deep_scan=True)
+    gs = np.repeat(np.arange(8), 10)
+    r1 = d1.drive(gs, ap.OP_LONG_ADD, 1)
+    r2 = d2.drive(gs, ap.OP_LONG_ADD, 1)
+    assert list(r1.results) == list(r2.results)
+    assert (rg1._stream_count == rg2._stream_count).all()
+
+    # second drive reuses the compiled scan (same shapes) and mixed
+    # per-op payloads take the non-const scatter path
+    ops = np.where(np.arange(80) % 2 == 0, ap.OP_LONG_ADD,
+                   ap.OP_VALUE_GET)
+    r1 = d1.drive(gs, ops, 2)
+    r2 = d2.drive(gs, ops, 2)
+    assert list(r1.results) == list(r2.results)
+
+    # session events (lock grant) surface identically through the
+    # stacked [W, ...] event path
+    for rg, d in ((rg1, d1), (rg2, d2)):
+        d.drive([0, 0], ap.OP_LOCK_ACQUIRE, [7, 8], -1)
+        d.drive([0], ap.OP_LOCK_RELEASE, 7)
+    assert rg1.events.get(0) == rg2.events.get(0)
+    assert any(code == ap.EV_LOCK_GRANT and target == 8
+               for _, code, target, _a in rg2.events.get(0, []))
