@@ -498,7 +498,8 @@ def run_session() -> dict:
         f"{per_group} ops/group/burst; "
         f"device={jax.devices()[0].platform}")
     rg.wait_for_leaders()
-    client = BulkSessionClient(rg)
+    client = BulkSessionClient(
+        rg, deep_scan=os.environ.get("COPYCAT_BENCH_SESSION_SCAN") == "1")
     sessions = [client.open_session() for _ in range(n_sessions)]
     # each session owns an equal slice of the groups (disjoint groups
     # keep per-session FIFO independent of scheduling order)

@@ -232,9 +232,10 @@ class BulkSessionClient:
     sessions' buffered commands commit in ONE drive per :meth:`flush`.
     """
 
-    def __init__(self, rg) -> None:
+    def __init__(self, rg, *, deep_scan: bool = False) -> None:
         self._rg = rg
-        self._driver = BulkDriver(rg, allow_sessions=True)
+        self._driver = BulkDriver(rg, allow_sessions=True,
+                                  deep_scan=deep_scan)
         self._registry = rg.sessions            # instantiates lazily
         self._sessions: dict[int, BulkSession] = {}
         self._closed: list[BulkSession] = []

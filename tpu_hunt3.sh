@@ -62,6 +62,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   run host5 COPYCAT_BENCH_SCENARIO=host COPYCAT_BENCH_GROUPS=10000 COPYCAT_BENCH_HOST_BURST=64 COPYCAT_BENCH_REPEATS=5
   run host_scan COPYCAT_BENCH_SCENARIO=host COPYCAT_BENCH_HOST_MODE=deepscan COPYCAT_BENCH_GROUPS=10000 COPYCAT_BENCH_HOST_BURST=64 COPYCAT_BENCH_REPEATS=5
   run session COPYCAT_BENCH_SCENARIO=session COPYCAT_BENCH_GROUPS=10000 COPYCAT_BENCH_HOST_BURST=64 COPYCAT_BENCH_REPEATS=3
+  run session_scan COPYCAT_BENCH_SCENARIO=session COPYCAT_BENCH_SESSION_SCAN=1 COPYCAT_BENCH_GROUPS=10000 COPYCAT_BENCH_HOST_BURST=64 COPYCAT_BENCH_REPEATS=3
   run mixed COPYCAT_BENCH_SCENARIO=mixed COPYCAT_BENCH_GROUPS=100000 COPYCAT_BENCH_PEERS=5 COPYCAT_BENCH_REPEATS=3
   run spi COPYCAT_BENCH_SCENARIO=spi COPYCAT_BENCH_SPI_BURSTS=3
   run spi_w2 COPYCAT_BENCH_SCENARIO=spi COPYCAT_BENCH_SPI_BURSTS=3 COPYCAT_BENCH_SPI_WAVES=2
@@ -79,13 +80,13 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   run map_read_atomic COPYCAT_BENCH_SCENARIO=map_read COPYCAT_BENCH_GROUPS=10000 COPYCAT_BENCH_READ_LEVEL=atomic COPYCAT_BENCH_REPEATS=3
   run election COPYCAT_BENCH_SCENARIO=election COPYCAT_BENCH_GROUPS=1000 COPYCAT_BENCH_REPEATS=3
   run host_read_atomic COPYCAT_BENCH_SCENARIO=host_read COPYCAT_BENCH_GROUPS=10000 COPYCAT_BENCH_HOST_BURST=64 COPYCAT_BENCH_READ_LEVEL=atomic COPYCAT_BENCH_REPEATS=3
-  if [ "$(wc -l < $STATE)" -ge 15 ] && ! grep -qx profile $STATE; then
+  if [ "$(wc -l < $STATE)" -ge 16 ] && ! grep -qx profile $STATE; then
     echo "=== $(date -u +%H:%M:%S) profile ===" >&2
     if bash /root/repo/tpu_profile_mixed.sh /tmp/mixed_trace_r05 >/tmp/hunt_profile.log 2>&1; then
       echo profile >> $STATE
       echo "    profile OK (/tmp/hunt_profile.log)" >&2
     fi
   fi
-  [ "$(wc -l < $STATE)" -ge 16 ] && { echo "hunt complete" >&2; break; }
+  [ "$(wc -l < $STATE)" -ge 17 ] && { echo "hunt complete" >&2; break; }
   sleep 120
 done
