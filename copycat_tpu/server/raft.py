@@ -382,6 +382,17 @@ class RaftServer(Managed):
             self._replication_tasks[peer] = asyncio.get_running_loop().create_task(
                 self._replicate_loop(peer))
         self._last_quorum_contact = {self.address: time.monotonic()}
+        # Reset every open session's contact clock: last_contact is
+        # LEADER-LOCAL wall time (replicated keep-alives advance only the
+        # deterministic log clock), so a re-elected leader would otherwise
+        # judge staleness from its PREVIOUS term's contacts and expire
+        # sessions that kept keep-aliving the interim leader all along —
+        # found by the partition+loss soak (tests/test_nemesis_raft.py).
+        # Every session gets one full timeout from takeover, the
+        # reference's new-leader grace.
+        now = time.monotonic()
+        for session in self.sessions.values():
+            session.last_contact = now
         # Commit an entry from this term immediately (Raft §5.4.2) and advance
         # the state machine clock.
         self._append(NoOpEntry())
