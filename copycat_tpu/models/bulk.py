@@ -671,6 +671,12 @@ class BulkDriver:
         # ONE compiled lax.scan dispatch.
         windows = int(np.ceil(B / S))
         tagl = np.zeros((G, 1), np.int32)
+        if self._scan and deliver_schedule is not None:
+            raise NotImplementedError(
+                "deep_scan compiles the whole blind phase with ONE "
+                "deliver mask; per-round deliver_schedule fault "
+                "injection needs the dispatch mode (BulkDriver without "
+                "deep_scan)")
         if self._scan:
             W_total = windows + 3      # + replicate/commit/report settle
             tagl_w = np.zeros((W_total, G, 1), np.int32)
