@@ -60,10 +60,23 @@ def main() -> None:
         s.submit_batch([0] * 8, ap.OP_LONG_ADD, 1)
     client.flush()
 
+    # wave 3: deep drive under a PARTIAL delivery mask — peer lane 2 cut
+    # everywhere (quorum {0,1} keeps committing; phase-2 suffix retries
+    # absorb any leader shuffle). Both processes install the same local
+    # mask — the staged global deliver stays lockstep-consistent.
+    cut = np.ones((4, 3, 3), bool)
+    cut[:, 2, :] = False
+    cut[:, :, 2] = False
+    healthy = rg.deliver
+    rg.deliver = rg._stage_deliver(cut)
+    s.submit_batch(np.arange(4), ap.OP_LONG_ADD, 100)
+    client.flush()
+    rg.deliver = healthy
+
     # read back through the lockstep query lane: local group 0 sums to
-    # per_group (+8 for process 0's second wave)
+    # per_group (+8 for process 0's second wave) + 100 from the fault wave
     v0 = rg.serve_query(0, ap.OP_VALUE_GET)
-    expect0 = per_group + (8 if pid == 0 else 0)
+    expect0 = per_group + (8 if pid == 0 else 0) + 100
 
     print("RESULT " + json.dumps(
         {"pid": pid, "fifo_ok": bool(fifo_ok), "v0": v0,
