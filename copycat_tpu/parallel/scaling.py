@@ -115,11 +115,15 @@ def _measure_bulk(n_devices: int, devices) -> dict:
     dt = time.perf_counter() - t0
 
     collectives = _deep_census(n_devices, devices, config)
+    # round 5: the fused scan program is a distinct compiled module —
+    # its zero-collective property is verified separately, not inherited
+    scan_collectives = _deep_scan_census(n_devices, devices, config)
     return {"devices": n_devices,
             "client_visible_ops_per_sec": round(g.size / dt),
             "drive_rounds": res.rounds,
             "warmup_s": round(warm_s, 1),
-            "collectives": collectives}
+            "collectives": collectives,
+            "scan_collectives": scan_collectives}
 
 
 def _deep_census(n_devices: int, devices, config) -> dict:
@@ -158,6 +162,49 @@ def _deep_census(n_devices: int, devices, config) -> dict:
     return _census_text(
         fn.lower(state, resbuf, valbuf, rndbuf, evflag, base,
                  np.int32(0), sub, deliver, key).compile().as_text())
+
+
+def _deep_scan_census(n_devices: int, devices, config,
+                      W: int = 4) -> dict:
+    """Census the round-5 ``deep_scan`` program (the whole blind phase
+    as one lax.scan) — a new compiled module, so the zero-collective
+    property must be re-verified, not inherited from deep_step."""
+    from functools import partial
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ..ops.consensus import (
+        Submits, deep_scan, full_delivery, init_state)
+    from ..parallel.mesh import shard_state
+
+    mesh = Mesh(np.asarray(devices[:n_devices]), ("groups",))
+    key = jax.random.PRNGKey(0)
+    key, init_key = jax.random.split(key)
+    state = shard_state(
+        init_state(CENSUS_GROUPS, PEERS, 32, init_key, config), mesh)
+    sh2 = NamedSharding(mesh, P("groups", None))
+    sh1 = NamedSharding(mesh, P("groups"))
+    resbuf = jax.device_put(jnp.zeros((CENSUS_GROUPS, 32), jnp.int32), sh2)
+    valbuf = jax.device_put(jnp.zeros((CENSUS_GROUPS, 32), bool), sh2)
+    rndbuf = jax.device_put(
+        jnp.full((CENSUS_GROUPS, 32), np.int32(2**30), jnp.int32), sh2)
+    evflag = jax.device_put(jnp.zeros(CENSUS_GROUPS, bool), sh1)
+    base = jax.device_put(jnp.zeros(CENSUS_GROUPS, jnp.int32), sh1)
+    sub_w = Submits(
+        opcode=np.zeros((W, CENSUS_GROUPS, 8), np.int32),
+        a=np.zeros((W, CENSUS_GROUPS, 8), np.int32),
+        b=np.zeros((W, CENSUS_GROUPS, 8), np.int32),
+        c=np.zeros((W, CENSUS_GROUPS, 8), np.int32),
+        tag=np.zeros((W, CENSUS_GROUPS, 1), np.int32),
+        valid=np.zeros((W, CENSUS_GROUPS, 8), bool))
+    deliver = jax.device_put(
+        full_delivery(CENSUS_GROUPS, PEERS),
+        NamedSharding(mesh, P("groups", None, None)))
+    fn = jax.jit(partial(deep_scan, config=config, onehot=True))
+    return _census_text(
+        fn.lower(state, resbuf, valbuf, rndbuf, evflag, base, sub_w,
+                 deliver, key).compile().as_text())
 
 
 def _measure(n_devices: int, devices) -> dict:
@@ -214,10 +261,12 @@ def main() -> None:
     no_collectives = all(not row["collectives"] for row in rows)
     bulk_rows = [_measure_bulk(n, devices) for n in (1, 2, 4, 8)]
     bulk_no_coll = all(not row["collectives"] for row in bulk_rows)
+    scan_no_coll = all(not row["scan_collectives"] for row in bulk_rows)
     result = {"groups": GROUPS, "peers": PEERS, "rounds": ROUNDS,
               "mesh_axis": "groups", "host_cores": host_cores,
               "no_cross_device_collectives": no_collectives,
               "bulk_no_cross_device_collectives": bulk_no_coll,
+              "deep_scan_no_cross_device_collectives": scan_no_coll,
               "table": rows, "bulk_table": bulk_rows}
 
     lines = [
@@ -276,6 +325,9 @@ def main() -> None:
         "",
         f"- deep_step cross-device collectives at 1/2/4/8 devices: "
         + ("**none** ✓" if bulk_no_coll else "**FOUND** ✗ (see JSON)"),
+        f"- deep_scan (round 5 — the whole blind phase as one lax.scan"
+        f" program) cross-device collectives at 1/2/4/8 devices: "
+        + ("**none** ✓" if scan_no_coll else "**FOUND** ✗ (see JSON)"),
         "",
         "| devices | client-visible ops/sec | drive rounds | collectives |",
         "|---|---|---|---|",

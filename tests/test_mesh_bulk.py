@@ -56,6 +56,18 @@ def test_deep_step_census_zero_collectives_on_mesh():
     assert _deep_census(8, devices, config) == {}
 
 
+def test_deep_scan_census_zero_collectives_on_mesh():
+    """The round-5 fused scan program is a DISTINCT compiled module; its
+    zero-collective property must be verified, not inherited."""
+    from copycat_tpu.parallel.scaling import _deep_scan_census
+
+    devices = jax.devices("cpu")
+    config = Config(append_window=8, applies_per_round=8,
+                    monotone_tag_accept=True)
+    assert _deep_scan_census(2, devices, config) == {}
+    assert _deep_scan_census(8, devices, config) == {}
+
+
 def test_census_positive_control():
     """The census must be able to SEE collectives — a broken tally that
     always returns {} would turn the scaling artifact into a false
