@@ -99,6 +99,18 @@ async def test_transport_fault_semantics():
         await ca.send(Put(key="s", value=7))
     nem.heal()
     assert await ca.send(Put(key="s", value=7)) == 7
+
+    # delay: a fixed floor is actually paid per message, and
+    # set_delay(x) means "exactly x" (the round-5 review fixed the
+    # min-without-max silent-zero footgun)
+    nem.set_delay(0.02)
+    t0 = asyncio.get_running_loop().time()
+    await ca.send(Put(key="t", value=8))
+    assert asyncio.get_running_loop().time() - t0 >= 0.02
+    with pytest.raises(ValueError):
+        nem.set_delay(0.01, 0.005)   # reversed bounds refuse loudly
+    nem.heal()
+    assert nem.delivered > 0
     await sa.close()
     await sb.close()
 
