@@ -217,14 +217,25 @@ class RaftClient(Managed):
             spawn(conn.close(), name="drop-connection")
 
     async def _request(self, request: Any, leader_required: bool = True,
-                       attempts: int = 30) -> Any:
-        """Send with retry/re-route until a non-routing error or success."""
+                       attempts: int = 30,
+                       per_try_timeout: float | None = None) -> Any:
+        """Send with retry/re-route until a non-routing error or success.
+
+        ``per_try_timeout`` bounds ONE attempt (default: the session
+        timeout). Keep-alives pass a fraction of it: an attempt stuck at
+        a stale leader (appended, never committable) otherwise burns the
+        whole session budget before re-routing — the session then
+        expires at the real leader even though the majority was
+        reachable all along (found by the partition nemesis once
+        new-leader expiry actually worked)."""
         backoff = 0.01
         last: Exception | None = None
+        tmo = per_try_timeout if per_try_timeout is not None \
+            else self.session_timeout
         for _ in range(attempts):
             try:
                 conn = await self._connect()
-                response = await asyncio.wait_for(conn.send(request), self.session_timeout)
+                response = await asyncio.wait_for(conn.send(request), tmo)
             except (TransportError, OSError, asyncio.TimeoutError) as e:
                 last = e
                 # A hinted leader that failed the attempt gets no second
@@ -270,10 +281,16 @@ class RaftClient(Managed):
         if not self._session.is_open:
             return
         try:
-            response = await self._request(msg.KeepAliveRequest(
-                session_id=self._session.id,
-                command_seq=self._acked_command_seq,
-                event_index=self._session.event_index))
+            response = await self._request(
+                msg.KeepAliveRequest(
+                    session_id=self._session.id,
+                    command_seq=self._acked_command_seq,
+                    event_index=self._session.event_index),
+                # timeout/4 = the keep-alive interval: a stuck attempt
+                # yields to the next tick's re-route, and the floor
+                # keeps slow-but-healthy commits (hundreds of ms) from
+                # spuriously dropping the shared connection
+                per_try_timeout=max(1.0, self._session.timeout / 4.0))
         except (msg.ProtocolError, TransportError, OSError, asyncio.TimeoutError):
             return
         if response.error == msg.UNKNOWN_SESSION:

@@ -120,7 +120,14 @@ async def test_transport_fault_semantics():
 # ---------------------------------------------------------------------------
 
 
+#: generous sessions throughout this module: partitions deliberately
+#: starve keep-alives, and a session expiring mid-choreography turns a
+#: lease/soak check into a SessionExpiredError timing flake
+SESSION_T = 30.0
+
+
 async def _nemesis_cluster(n=3, **kwargs) -> tuple[Cluster, NetworkNemesis]:
+    kwargs.setdefault("session_timeout", SESSION_T)
     cluster = await create_cluster(n, **kwargs)
     nem = cluster.registry.attach_nemesis()
     return cluster, nem
@@ -136,7 +143,7 @@ async def test_stale_leader_refuses_lease_read_under_asymmetric_partition():
     cluster, nem = await _nemesis_cluster()
     try:
         leader = await cluster.await_leader()
-        client = await cluster.client()
+        client = await cluster.client(session_timeout=SESSION_T)
         assert await client.submit(Put(key="k", value=1)) is None
         # lease-read sanity while healthy
         assert await client.submit(BoundedGet(key="k")) == 1
@@ -176,7 +183,7 @@ async def test_majority_progress_and_stale_leader_refusal_symmetric():
     cluster, nem = await _nemesis_cluster()
     try:
         old = await cluster.await_leader()
-        client = await cluster.client()
+        client = await cluster.client(session_timeout=SESSION_T)
         assert await client.submit(Put(key="k", value=1)) is None
 
         minority = [old.address]
@@ -185,7 +192,7 @@ async def test_majority_progress_and_stale_leader_refusal_symmetric():
 
         # majority side elects and commits a NEWER value
         maj_client = RaftClient(majority, LocalTransport(cluster.registry),
-                                session_timeout=2.0)
+                                session_timeout=SESSION_T)
         await maj_client.open()
         cluster.clients.append(maj_client)
         assert await asyncio.wait_for(
@@ -233,10 +240,10 @@ async def test_soak_partitions_and_loss_exactly_once():
     # starve keep-alives for seconds, and an expiry mid-soak fails the
     # run with SessionExpiredError — a timing artifact, not a finding
     cluster, nem = await _nemesis_cluster(
-        session_timeout=30.0)
+        session_timeout=SESSION_T)
     try:
         await cluster.await_leader()
-        client = await cluster.client(session_timeout=30.0)
+        client = await cluster.client(session_timeout=SESSION_T)
         nem.set_loss(request=0.15, response=0.10)
         nem.set_delay(0.0, 0.003)
 
