@@ -25,14 +25,15 @@ class DistributedAtomicValue(AbstractResource):
         return await self.submit(commands.Get())
 
     async def set(self, value: Any, ttl: float | None = None) -> None:
-        await self.submit(commands.Set(value=value, ttl=ttl))
+        await self.submit_command(commands.Set(value=value, ttl=ttl))
 
     async def get_and_set(self, value: Any, ttl: float | None = None) -> Any:
-        return await self.submit(commands.GetAndSet(value=value, ttl=ttl))
+        return await self.submit_command(
+            commands.GetAndSet(value=value, ttl=ttl))
 
     async def compare_and_set(self, expect: Any, update: Any,
                               ttl: float | None = None) -> bool:
-        return bool(await self.submit(
+        return bool(await self.submit_command(
             commands.CompareAndSet(expect=expect, update=update, ttl=ttl)))
 
     async def on_change(self, callback: Callable[[Any], Any]) -> Listener:

@@ -581,6 +581,18 @@ def run_spi() -> dict:
     payload = os.environ.get("COPYCAT_BENCH_SPI_PAYLOAD", "int")
     if payload not in ("int", "str"):
         raise SystemExit(f"COPYCAT_BENCH_SPI_PAYLOAD={payload!r}: int|str")
+    # Engine pool provisioning (DeviceEngineConfig.resource): the counter
+    # scenario hosts only value registers, and pool state is carried
+    # through every engine round — counters-only provisioning measured
+    # the loaded round 9.3 -> 5.1 ms at capacity 1024 on CPU. The str
+    # (shadow-cliff) scenario needs the map pool live, so it keeps all
+    # pools; override with COPYCAT_BENCH_SPI_POOLS=counters|all.
+    pools = os.environ.get("COPYCAT_BENCH_SPI_POOLS",
+                           "counters" if payload == "int" else "all")
+    if pools not in ("counters", "all"):
+        raise SystemExit(f"COPYCAT_BENCH_SPI_POOLS={pools!r}: counters|all")
+    engine_pools = (ResourceConfig.counters_only() if pools == "counters"
+                    else None)
     # client pipelining depth: each session keeps WAVES commands in
     # flight per instance (sequential per instance — FIFO preserved).
     # Depth 2 overlaps the client/submit stack with the window pump
@@ -592,6 +604,12 @@ def run_spi() -> dict:
     # stack's share of the client-visible number
     transport_kind = os.environ.get("COPYCAT_BENCH_SPI_TRANSPORT", "local")
     capacity = 1 << max(4, (instances - 1).bit_length())  # pow2 >= instances
+    # Engine ring: the spi steady state keeps ≤1 in-flight entry per
+    # group (one public op per instance per burst), so the 32-slot ring
+    # round 5 ran was 2x headroom paid in one-hot pass width every
+    # round; 16 measured -0.3 ms/loaded round at G=1024 with identical
+    # commit behavior. Override for deeper per-group pipelining.
+    log_slots = int(os.environ.get("COPYCAT_BENCH_SPI_LOG_SLOTS", "16"))
     registry = LocalServerRegistry()  # shared by both ends in local mode
 
     def make_transport():
@@ -622,8 +640,8 @@ def run_spi() -> dict:
             election_timeout=0.5, heartbeat_interval=0.1,
             session_timeout=60.0, executor="tpu",
             engine_config=DeviceEngineConfig(
-                capacity=capacity, num_peers=PEERS, log_slots=32,
-                submit_slots=4))
+                capacity=capacity, num_peers=PEERS, log_slots=log_slots,
+                submit_slots=4, resource=engine_pools))
         await server.open()
         client = AtomixClient([addr], transport,
                               session_timeout=60.0)
@@ -645,6 +663,17 @@ def run_spi() -> dict:
                 f"{time.perf_counter() - t0:.1f}s; {on_device} on-device "
                 f"(capacity {capacity}); device="
                 f"{jax.devices()[0].platform}")
+            # GC tuning (the production-server treatment): a 1k-op burst
+            # allocates ~20k short-lived objects (tasks, futures,
+            # messages); with default thresholds a gen-2 pass lands mid-
+            # burst and the collector walks the whole live server — 30+
+            # ms, a 3-4x swing between otherwise identical reps. Freeze
+            # the settled heap out of collection and raise gen0 so
+            # cyclic garbage is still collected, just between bursts.
+            import gc
+            gc.collect()
+            gc.freeze()
+            gc.set_threshold(100_000, 50, 100)
 
             lats: list[float] = []
             n_op = [0]

@@ -135,6 +135,7 @@ class RaftClient(Managed):
         self.client_id = f"client-{uuid.uuid4().hex[:8]}-{next(_client_counter)}"
 
         self._client = transport.client()
+        self._loop: asyncio.AbstractEventLoop | None = None  # pinned at open
         self._connection: Connection | None = None
         self._connected_to: Address | None = None
         self._leader_hint: Address | None = None
@@ -169,6 +170,7 @@ class RaftClient(Managed):
         return self._index
 
     async def _do_open(self) -> None:
+        self._loop = asyncio.get_running_loop()
         await self._register()
         interval = max(self._session.timeout / 4.0, 0.05)
         self._keepalive = Scheduled(interval, interval, self._send_keepalive)
@@ -320,18 +322,25 @@ class RaftClient(Managed):
             return await self._submit_query(operation)
         return await self._submit_command(operation)
 
-    async def _submit_command(self, operation: Command) -> Any:
+    def submit_command_nowait(self, operation: Command) -> "asyncio.Future":
+        """Stage one command into the current micro-batch and return its
+        future directly (no coroutine frame). The awaitable-returning hot
+        path: resource facades flatten their submit chain through this,
+        cutting ~4 async frames per op off the public SPI plane."""
         if not self._session.is_open:
             raise SessionExpiredError("session is not open")
         self._command_seq += 1
         seq = self._command_seq
-        loop = asyncio.get_running_loop()
+        loop = self._loop  # pinned at open: one lookup per op saved
         fut: asyncio.Future = loop.create_future()
         self._pending_batch.append((seq, operation, fut))
         if not self._batch_scheduled:
             self._batch_scheduled = True
             loop.call_soon(self._launch_batch)
-        return await fut
+        return fut
+
+    async def _submit_command(self, operation: Command) -> Any:
+        return await self.submit_command_nowait(operation)
 
     def _launch_batch(self) -> None:
         self._batch_scheduled = False
@@ -367,10 +376,20 @@ class RaftClient(Managed):
                 if not fut.done():
                     fut.set_exception(e)
             return
-        by_seq = {entry[0]: entry for entry in (response.entries or [])}
+        resp_entries = response.entries or []
+        # positional fast path: the server answers in request order, so
+        # the common case correlates by zip — the by-seq dict is built
+        # only when shapes/seqs disagree (partial or reordered response).
+        # The seq comparison runs as two listcomps + one C-level list
+        # compare (measurably cheaper than a per-pair generator walk).
+        if len(resp_entries) == len(batch) and \
+                [e[0] for e in resp_entries] == [b[0] for b in batch]:
+            paired = zip(batch, resp_entries)
+        else:
+            by_seq = {entry[0]: entry for entry in resp_entries}
+            paired = ((b, by_seq.get(b[0])) for b in batch)
         try:
-            for seq, _, fut in batch:
-                entry = by_seq.get(seq)
+            for (seq, _, fut), entry in paired:
                 if entry is None:
                     if not fut.done():
                         fut.set_exception(msg.ProtocolError(
@@ -403,8 +422,13 @@ class RaftClient(Managed):
         """Per-command success bookkeeping (the _finish tail): advance the
         sequential-read index and the contiguous completed-seq prefix the
         keep-alive acks for server response-cache pruning."""
-        if index:
-            self._index = max(self._index, index)
+        if index and index > self._index:
+            self._index = index
+        # in-order completion (every batch entry in a healthy run): just
+        # bump the prefix — the out-of-order set stays untouched/empty
+        if seq == self._acked_command_seq + 1 and not self._completed_seqs:
+            self._acked_command_seq = seq
+            return
         self._completed_seqs.add(seq)
         while self._acked_command_seq + 1 in self._completed_seqs:
             self._acked_command_seq += 1

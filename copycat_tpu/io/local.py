@@ -201,6 +201,14 @@ class LocalConnection(Connection):
             # Same marshalling contract as TcpConnection: handler errors cross
             # the transport as TransportError("Type: message").
             raise TransportError(f"{type(exc).__name__}: {exc}") from exc
+        if nem is not None:
+            # symmetric per-message delay: the response leg pays the same
+            # latency draw as the request leg (a real network delays both
+            # directions), and pays it BEFORE the drop evaluation — a
+            # dropped response still spent its wire time
+            d = nem.delay_s()
+            if d:
+                await asyncio.sleep(d)
         if nem is not None and nem.drop_response(self.local_address,
                                                  self.remote_address):
             # the handler RAN; only the reply is lost — the sender must

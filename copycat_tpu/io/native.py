@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import ctypes
+import logging
 import pathlib
 import subprocess
 import threading
@@ -33,6 +34,8 @@ from .transport import (
     Transport,
     TransportError,
 )
+
+logger = logging.getLogger(__name__)
 
 _REQUEST, _RESPONSE, _ERROR = 0, 1, 2
 _ETYPE_ACCEPT, _ETYPE_FRAME, _ETYPE_CLOSE, _ETYPE_CONNECT = 1, 2, 3, 4
@@ -105,43 +108,76 @@ class _NativeLoop:
     def bind_asyncio(self, loop: asyncio.AbstractEventLoop) -> None:
         self._aio = loop
 
+    #: max events drained per poller wake — bounds the latency one
+    #: burst handoff can add to the event at the back of the queue
+    BURST_MAX = 256
+
     def _poller(self) -> None:
         conn = ctypes.c_int()
         etype = ctypes.c_int()
         kind = ctypes.c_uint8()
         corr = ctypes.c_uint64()
-        while not self._stop.is_set():
-            n = self._lib.cn_poll(self._handle, 100, ctypes.byref(conn),
-                                  ctypes.byref(etype), ctypes.byref(kind),
-                                  ctypes.byref(corr), self._buf, self._cap)
-            if n == -1:
-                continue
-            if n == -2:  # grow and re-poll; the event was kept queued
-                self._cap = max(self._cap * 2, int(corr.value) + 1)
-                self._buf = ctypes.create_string_buffer(self._cap)
-                continue
-            payload = self._buf.raw[:n] if n > 0 else b""
-            self._dispatch(conn.value, etype.value, kind.value,
-                           int(corr.value), payload)
 
-    def _dispatch(self, conn: int, etype: int, kind: int, corr: int,
-                  payload: bytes) -> None:
+        def poll_one(timeout_ms: int):
+            """One cn_poll; None when idle, else the event tuple."""
+            while True:
+                n = self._lib.cn_poll(self._handle, timeout_ms,
+                                      ctypes.byref(conn), ctypes.byref(etype),
+                                      ctypes.byref(kind), ctypes.byref(corr),
+                                      self._buf, self._cap)
+                if n == -1:
+                    return None
+                if n == -2:  # grow and re-poll; the event was kept queued
+                    self._cap = max(self._cap * 2, int(corr.value) + 1)
+                    self._buf = ctypes.create_string_buffer(self._cap)
+                    continue
+                payload = self._buf.raw[:n] if n > 0 else b""
+                return (conn.value, etype.value, kind.value,
+                        int(corr.value), payload)
+
+        while not self._stop.is_set():
+            ev = poll_one(100)
+            if ev is None:
+                continue
+            # Burst handoff: drain everything already queued in the C
+            # loop (zero-timeout polls) and cross the thread boundary
+            # ONCE — one call_soon_threadsafe per burst instead of per
+            # frame kept the poller from scheduling N asyncio callbacks
+            # for an N-frame read burst.
+            burst = [ev]
+            while len(burst) < self.BURST_MAX:
+                ev = poll_one(0)
+                if ev is None:
+                    break
+                burst.append(ev)
+            self._dispatch_burst(burst)
+
+    def _dispatch_burst(self, burst: list) -> None:
         aio = self._aio
         if aio is None or aio.is_closed():
             return
         # Route lookups must happen IN the asyncio thread: an ACCEPT's
         # callback (which registers the route) and the first FRAME arrive
-        # back-to-back from the poller, and call_soon_threadsafe preserves
-        # their order only inside the loop.
+        # back-to-back from the poller, and in-burst order is preserved
+        # by delivering the whole burst inside one loop callback.
         def deliver() -> None:
-            if etype == _ETYPE_ACCEPT:
-                fn = self._accepts.get(corr)  # corr = listener conn id
-                if fn is not None:
-                    fn(conn)
-                return
-            route = self._routes.get(conn)
-            if route is not None:
-                route(etype, kind, corr, payload)
+            for conn, etype, kind, corr, payload in burst:
+                # per-event isolation: one raising callback must not drop
+                # the rest of the burst (the per-frame call_soon design
+                # isolated failures for free; the burst handoff must too)
+                try:
+                    if etype == _ETYPE_ACCEPT:
+                        fn = self._accepts.get(corr)  # corr = listener conn
+                        if fn is not None:
+                            fn(conn)
+                        continue
+                    route = self._routes.get(conn)
+                    if route is not None:
+                        route(etype, kind, corr, payload)
+                except Exception:
+                    logger.exception(
+                        "native poller: event callback failed "
+                        "(conn=%d etype=%d)", conn, etype)
 
         try:
             aio.call_soon_threadsafe(deliver)

@@ -6,6 +6,16 @@ serializer, so anything that crosses LocalTransport crosses TCP identically.
 This is the DCN/gRPC-role host-side transport of the TPU design (SURVEY.md
 §5.8): client sessions and cross-slice traffic ride here, while intra-step
 quorum traffic rides ICI collectives inside the compiled engine.
+
+Burst handoff: the read loop drains whole socket reads and walks EVERY
+complete frame in one pass — through the native codec's
+``decode_frames`` (C: header walk + per-frame payload decode in one
+call) when the extension is built, else a Python ``struct`` walk. A
+burst of N frames costs one ``read()`` await + one frame walk instead
+of 2N ``readexactly`` awaits, which is where the per-message asyncio
+scheduling cost of the old loop lived. Handlers still run as
+independent tasks (a burst must not serialize request handling — a
+blocking command must never delay a keep-alive sharing its connection).
 """
 
 from __future__ import annotations
@@ -14,6 +24,7 @@ import asyncio
 import struct
 from typing import Any, Callable
 
+from .codec import codec
 from .serializer import Serializer
 from .transport import (
     Address,
@@ -41,41 +52,108 @@ class TcpConnection(Connection):
         self._pending: dict[int, asyncio.Future] = {}
         self._reader_task = asyncio.get_running_loop().create_task(self._read_loop())
 
+    def _walk_frames(self, buf: bytes | bytearray) -> tuple[list, int]:
+        """Every complete frame in ``buf`` as ``(kind, corr, message,
+        ok)`` records plus the bytes consumed. The C walk handles the
+        whole burst in one call; any frame it cannot express (>64-bit
+        ints, unregistered types, torn payload) re-runs the burst in
+        Python, where per-frame decode errors become error records so
+        one bad frame fails one request, not the connection."""
+        c = codec()
+        if c is not None:
+            try:
+                frames, consumed = c.decode_frames(buf)
+                return [(k, co, m, True) for k, co, m in frames], consumed
+            except Exception:
+                pass
+        frames: list = []
+        pos = 0
+        n = len(buf)
+        while pos + _HEADER.size <= n:
+            length, kind, corr = _HEADER.unpack_from(buf, pos)
+            end = pos + _HEADER.size + length
+            if end > n:
+                break
+            # bytes() copy: the read loop hands a mutable bytearray, and
+            # decoded byte-typed fields must stay `bytes` downstream
+            payload = bytes(buf[pos + _HEADER.size:end])
+            try:
+                frames.append((kind, corr, self._serializer.read(payload),
+                               True))
+            except Exception as exc:  # noqa: BLE001 — marshalled per frame
+                frames.append((kind, corr, exc, False))
+            pos = end
+        return frames, pos
+
     async def _read_loop(self) -> None:
+        # bytearray accumulation: `+=` is amortized O(n) and `del` of the
+        # consumed prefix is linear, so a frame spanning many 64 KiB
+        # reads costs one pass — bytes concatenation per chunk re-copied
+        # the whole pending frame every read (quadratic in frame size)
+        buf = bytearray()
+        loop = asyncio.get_running_loop()
         try:
             while True:
-                header = await self._reader.readexactly(_HEADER.size)
-                length, kind, corr = _HEADER.unpack(header)
-                payload = await self._reader.readexactly(length)
-                if kind == _REQUEST:
-                    asyncio.get_running_loop().create_task(self._serve(corr, payload))
-                else:
-                    future = self._pending.pop(corr, None)
-                    if future is not None and not future.done():
-                        if kind == _ERROR:
-                            future.set_exception(TransportError(self._serializer.read(payload)))
-                        else:
-                            future.set_result(self._serializer.read(payload))
+                chunk = await self._reader.read(1 << 16)
+                if not chunk:
+                    break
+                buf += chunk
+                frames, consumed = self._walk_frames(buf)
+                if consumed:
+                    del buf[:consumed]
+                for kind, corr, message, ok in frames:
+                    if kind == _REQUEST:
+                        if ok:
+                            loop.create_task(self._serve(corr, message))
+                        else:  # decode error: fail THIS request only
+                            self._write_error(corr, message)
+                    else:
+                        future = self._pending.pop(corr, None)
+                        if future is not None and not future.done():
+                            if not ok:
+                                future.set_exception(TransportError(
+                                    f"{type(message).__name__}: {message}"))
+                            elif kind == _ERROR:
+                                future.set_exception(TransportError(message))
+                            else:
+                                future.set_result(message)
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
             pass
         finally:
             self._abort()
 
-    async def _serve(self, corr: int, payload: bytes) -> None:
+    async def _serve(self, corr: int, message: Any) -> None:
         try:
-            message = self._serializer.read(payload)
             result = await self._handle(message)
-            self._write_frame(_RESPONSE, corr, self._serializer.write(result))
+            self._write_message(_RESPONSE, corr, result)
         except Exception as exc:  # marshal handler errors back to the caller
-            try:
-                self._write_frame(_ERROR, corr, self._serializer.write(f"{type(exc).__name__}: {exc}"))
-            except Exception:
-                pass
+            self._write_error(corr, exc)
+
+    def _write_error(self, corr: int, exc: Any) -> None:
+        try:
+            self._write_message(_ERROR, corr,
+                                f"{type(exc).__name__}: {exc}")
+        except Exception:
+            pass
 
     def _write_frame(self, kind: int, corr: int, payload: bytes) -> None:
         if self.closed:
             raise ConnectionClosedError("connection closed")
         self._writer.write(_HEADER.pack(len(payload), kind, corr) + payload)
+
+    def _write_message(self, kind: int, corr: int, message: Any) -> None:
+        """Frame + encode in one C pass when the codec is available (the
+        header pack and bytes concat disappear into ``encode_frames``)."""
+        if self.closed:
+            raise ConnectionClosedError("connection closed")
+        c = codec()
+        if c is not None:
+            try:
+                self._writer.write(c.encode_frames([(kind, corr, message)]))
+                return
+            except Exception:  # Fallback etc. — the Python path decides
+                pass
+        self._write_frame(kind, corr, self._serializer.write(message))
 
     async def send(self, message: Any) -> Any:
         if self.closed:
@@ -84,7 +162,7 @@ class TcpConnection(Connection):
         corr = self._next_id
         future: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[corr] = future
-        self._write_frame(_REQUEST, corr, self._serializer.write(message))
+        self._write_message(_REQUEST, corr, message)
         await self._writer.drain()
         return await future
 

@@ -87,6 +87,14 @@ class InstanceClient:
     def session(self) -> InstanceSession:
         return self._session
 
+    def submit_command_nowait(self, operation: Operation) -> Any:
+        """Future-returning command submit (the flattened hot path):
+        wraps in the instance envelope and stages straight into the
+        parent client's micro-batch. Plain commands only — delete
+        chaining and queries keep the coroutine path."""
+        return self.client.submit_command_nowait(
+            InstanceCommand(self.instance_id, operation))
+
     async def submit(self, operation: Operation) -> Any:
         if isinstance(operation, DeleteCommand):
             # Reference InstanceClient.java:73-75: resource-level delete, then

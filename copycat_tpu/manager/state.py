@@ -20,6 +20,7 @@ from typing import Any, Callable
 
 from ..server.session import ServerSession, SessionState
 from ..server.state_machine import Commit, StateMachine, StateMachineExecutor
+from ..resource.operations import ResourceCommand
 from ..resource.state_machine import ResourceStateMachine, ResourceStateMachineExecutor
 from .operations import (
     CreateResource,
@@ -249,6 +250,34 @@ class ResourceManager(StateMachine):
         reparented = _ReparentedCommit(commit, instance.session, op.operation)
         return instance.resource.executor.execute(reparented)
 
+    # -- batched server-side pump (vector lane) ---------------------------
+
+    def vector_route(self, operation: Any):
+        """Classify one committed operation for the applying server's
+        vector lane: ``(machine, instance, inner_op, spec)`` when the op
+        is a routed resource command whose device-backed machine can
+        express it as ONE device op (``DeviceBackedStateMachine.
+        vector_spec``), else ``None`` — the per-entry windowed apply
+        handles everything else. Exact-type checks keep subclasses (which
+        may override semantics) on the general path."""
+        if type(operation) is not InstanceCommand:
+            return None
+        envelope = operation.operation
+        if type(envelope) is not ResourceCommand:
+            return None
+        instance = self.instances.get(operation.resource)
+        if instance is None:
+            return None
+        machine = instance.resource.state_machine
+        spec_fn = getattr(machine, "vector_spec", None)
+        if spec_fn is None:
+            return None
+        inner = envelope.operation
+        spec = spec_fn(inner)
+        if spec is None:
+            return None
+        return machine, instance, inner, spec
+
     # -- internals ---------------------------------------------------------
 
     def _get_or_create_resource(self, commit: Commit, key: str,
@@ -278,7 +307,8 @@ class ResourceManager(StateMachine):
         engine still has a free group (fallback otherwise)."""
         if self.executor_kind == "tpu":
             from .device_executor import device_machine_for
-            device_cls = device_machine_for(machine_cls)
+            device_cls = device_machine_for(
+                machine_cls, self.device_engine.config.resource)
             if device_cls is not None:
                 group = self.device_engine.allocate()
                 if group is not None:

@@ -682,14 +682,13 @@ class BulkDriver:
             tagl_w = np.zeros((W_total, G, 1), np.int32)
             valid_w = np.zeros((W_total, G, S), bool)
 
-            def _payload_w(c, v):
+            def _payload_w(c):
                 arr = np.zeros((W_total, G, S), np.int32)
                 if c is not None:
                     arr[:windows] = c     # burst-uniform: one fill
                 return arr
 
-            op_w, a_w, b_w, c_w = (
-                _payload_w(c, v) for c, v in zip(consts, vals))
+            op_w, a_w, b_w, c_w = (_payload_w(c) for c in consts)
             win_of = rank // S
             slot_of = rank - win_of * S
             for w in range(windows):

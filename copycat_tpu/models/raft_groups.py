@@ -678,11 +678,15 @@ class RaftGroups:
             failed = self.metrics.counter("ops_refused")
             for g, s in zip(*np.nonzero(refused & valid)):
                 tag = int(submits.tag[g, s])
+                # recorded for UNTRACKED tags too: drive_vector's rows
+                # have no _inflight entry, and without the FAIL record a
+                # refused row would spin the whole run to TimeoutError —
+                # failing rows that DID commit on device
+                self.results[tag] = FAIL
+                failed.inc()
                 if tag in self._inflight:
                     self._inflight.pop(tag)
                     self._inflight_ops.pop(tag, None)
-                    self.results[tag] = FAIL
-                    failed.inc()
         rejected = valid & ~acc & ~refused
         if not rejected.any():
             return
@@ -704,10 +708,13 @@ class RaftGroups:
                 if any(te < self._leader_term[g] for _, te in pend.values()):
                     self._held.add(g)
         valid = np.asarray(out.out_valid)
-        if valid.any():
+        if valid.any() and (self._inflight or self._placements):
             # flat native-int views: per-element numpy scalar indexing and
             # int() conversion in this loop were a measurable share of the
-            # client-visible op cost at 10k groups
+            # client-visible op cost at 10k groups. Skipped entirely when
+            # nothing is tracked — untracked commits (the vector drive's
+            # rows, which correlate from the step outputs themselves)
+            # have no routing to do here.
             gi, ii = np.nonzero(valid)
             g_l = gi.tolist()
             tags_l = np.asarray(out.out_tag)[gi, ii].tolist()
@@ -860,6 +867,71 @@ class RaftGroups:
                          for i in seg_l)
         self.metrics.counter("ops_submitted").inc(n)
         return tags
+
+    def drive_vector(self, groups, opcode, a, b, c,
+                     max_rounds: int = 200) -> np.ndarray | None:
+        """One-shot vectorized drive for full-delivery engines (the
+        applying server's batched pump): stage every row straight into
+        the next round's submit buffer, step shared rounds until all
+        rows committed, and correlate results FROM THE STEP OUTPUTS in
+        one numpy pass per round — no per-op tag dicts, no harvest
+        routing, no result-cache churn. Returns results aligned with the
+        input rows, or ``None`` when direct staging is refused (queued
+        ops, holds, monotone engines, overfull groups) and the caller
+        must take the tracked :meth:`submit_batch` path.
+
+        Per-group FIFO holds because ``_stage_direct``'s stable group
+        sort preserves row order within a group and the engine applies
+        accepted slots in log order; a rejected row (rare: group mid-
+        election) is requeued by ``_requeue_rejected`` and caught by a
+        later round's correlation pass."""
+        g = np.asarray(groups, np.int64)
+        n = g.size
+        tags = np.arange(self._next_tag, self._next_tag + n)
+        if not self._stage_direct(g, np.asarray(opcode, np.int64),
+                                  np.asarray(a, np.int64),
+                                  np.asarray(b, np.int64),
+                                  np.asarray(c, np.int64), tags):
+            return None
+        self._next_tag += n
+        tag0 = tags[0] if n else 0
+        res = np.zeros(n, np.int64)
+        done = np.zeros(n, bool)
+        self.metrics.counter("ops_submitted").inc(n)
+        remaining = n
+        for _ in range(max_rounds):
+            out = self.step_round()
+            valid = np.asarray(out.out_valid)
+            if valid.any():
+                gi, ii = np.nonzero(valid)
+                t = np.asarray(out.out_tag)[gi, ii]
+                mine = (t >= tag0) & (t < tag0 + n)
+                if mine.any():
+                    k = (t[mine] - tag0).astype(np.int64)
+                    fresh = ~done[k]
+                    k = k[fresh]
+                    res[k] = np.asarray(out.out_result)[gi, ii][mine][fresh]
+                    done[k] = True
+                    remaining -= k.size
+            if remaining and self.results:
+                # terminal refusals (_requeue_rejected records FAIL for
+                # this block's tags): resolve those rows to the sentinel
+                # so the rest of the run still returns — the caller maps
+                # FAIL to a per-row error
+                for t in [t for t in self.results
+                          if tag0 <= t < tag0 + n]:
+                    k = int(t - tag0)
+                    v = self.results.pop(t)
+                    if not done[k]:
+                        res[k] = v
+                        done[k] = True
+                        remaining -= 1
+            if remaining == 0:
+                self.metrics.counter("ops_committed").inc(n)
+                return res
+        raise TimeoutError(
+            f"vector drive: {remaining}/{n} rows uncommitted after "
+            f"{max_rounds} rounds")
 
     def add_peer(self, group: int, peer: int) -> int:
         """Add ``peer``'s lane to ``group``'s voter set (the reference's

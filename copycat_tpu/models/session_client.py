@@ -48,6 +48,7 @@ committed ops/s on one chip through THIS sessioned surface.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, NamedTuple
 
 import numpy as np
@@ -55,6 +56,8 @@ import numpy as np
 from ..utils.listeners import Listener, Listeners
 from .bulk import BulkDriver
 from .sessions import DeviceSession, SessionExpiredError
+
+logger = logging.getLogger(__name__)
 
 
 class CommandIndeterminateError(RuntimeError):
@@ -107,7 +110,9 @@ class BulkSession:
         self.id = dev.id
         self._next_seq = 0
         self._pending: list[_Chunk] = []
-        self._results: dict[int, int] = {}      # seq -> result (cache)
+        # seq -> committed result, or the _INDETERMINATE/_EXPIRED
+        # sentinel objects (identity-compared in result())
+        self._results: dict[int, int | object] = {}
         # group -> (Listeners, last-delivered event seq)
         self._subs: dict[int, tuple[Listeners, int]] = {}
 
@@ -276,6 +281,14 @@ class BulkSessionClient:
         #    SessionExpiredError (the reference's unknown-session
         #    command failure).
         chunks: list[tuple[BulkSession | None, _Chunk]] = []
+        # Sessions leaving this client after THIS flush (graceful closes
+        # whose fan-out commits here, expiries detected here). They stay
+        # in _sessions until after _deliver_events: the reference's
+        # deliver-until-close contract — a close's own final events
+        # (lock release grants, election promotions) reach the closing
+        # session's listeners on the flush that commits the close, not
+        # never.
+        leaving: list[BulkSession] = []
         for s in list(self._sessions.values()):
             if s._dev.expired:
                 for ch in s._pending:
@@ -283,13 +296,12 @@ class BulkSessionClient:
                         (q, _EXPIRED)
                         for q in range(ch.seq0, ch.seq0 + ch.groups.size))
                 s._pending = []
-                self._sessions.pop(s.id, None)
+                leaving.append(s)
                 continue
             for ch in s._pending:
                 chunks.append((s, ch))
             s._pending = []
-        for s in self._closed:
-            self._sessions.pop(s.id, None)
+        leaving.extend(self._closed)
         self._closed.clear()
         cleanup = self._registry.pending_cleanup
         if cleanup:
@@ -305,26 +317,52 @@ class BulkSessionClient:
         if chunks or getattr(rg, "process_count", 1) > 1:
             cat = lambda i: (np.concatenate([c[i] for _, c in chunks])
                              if chunks else np.zeros(0, np.int64))
+            tag_mark = rg._next_tag
             try:
                 res = self._driver.drive(cat(1), cat(2), cat(3), cat(4),
                                          cat(5), max_rounds=max_rounds)
-            except Exception:
-                # Abandoned drive (fault-envelope violation). Cleanup ops
-                # are RE-STAGED — CANCEL/RELEASE/RESIGN are idempotent
-                # no-ops when already applied, so retrying them is always
-                # safe, and dropping them would wedge a dead session's
-                # locks forever. Session commands are INDETERMINATE (they
-                # may have committed); mark them so result() reports the
-                # truth instead of a bare KeyError.
+            except Exception as exc:
                 if cleanup:
+                    # Cleanup ops are RE-STAGED on every failure —
+                    # CANCEL/RELEASE/RESIGN are idempotent no-ops when
+                    # already applied, so retrying them is always safe,
+                    # and dropping them would wedge a dead session's
+                    # locks forever.
                     self._registry.pending_cleanup = (
                         cleanup + self._registry.pending_cleanup)
-                for s, ch in chunks:
-                    if s is not None:
-                        s._results.update(
-                            (q, _INDETERMINATE)
-                            for q in range(ch.seq0,
-                                           ch.seq0 + ch.groups.size))
+                if (isinstance(exc, TimeoutError)
+                        or rg._next_tag != tag_mark):
+                    # Abandoned drive (fault-envelope violation), or any
+                    # error raised AFTER the drive reserved its tag block
+                    # — device dispatch may have begun, so the commands
+                    # may have committed. Mark them INDETERMINATE so
+                    # result() reports the truth instead of a bare
+                    # KeyError. The tag-counter check is the dispatch
+                    # boundary: exception TYPE alone must not decide this
+                    # (an XLA runtime error mid-drive is not a preflight
+                    # refusal, and restoring it for retry would
+                    # double-apply non-idempotent ops).
+                    for s, ch in chunks:
+                        if s is not None:
+                            s._results.update(
+                                (q, _INDETERMINATE)
+                                for q in range(ch.seq0,
+                                               ch.seq0 + ch.groups.size))
+                else:
+                    # Raised BEFORE any device dispatch (the drive's
+                    # preflight refusals: tag-space OverflowError,
+                    # accumulator-skew ValueError) — no tags were
+                    # consumed, so these commands definitely did not
+                    # apply. Restore them to their sessions' _pending
+                    # (original order: the chunk walk preserves
+                    # per-session submission order) and re-raise; the
+                    # caller can split the burst and re-flush without
+                    # the correlate-a-read recovery path.
+                    for s, ch in chunks:
+                        if s is not None:
+                            s._pending.append(ch)
+                self._closed.extend(
+                    s for s in leaving if not s._dev.expired)
                 raise
             # 4. correlate: slice results back per chunk, cache by seq.
             off = 0
@@ -344,9 +382,23 @@ class BulkSessionClient:
         while rg._any_across(bool(rg._queues)) and pump < 16:
             rg.step_round()
             pump += 1
+        if pump >= 16 and rg._any_across(bool(rg._queues)):
+            # Backpressure: the expiry/close fan-out (lock releases,
+            # resigns) did not drain within the cap — it is deferred to
+            # a later flush's pump. Loud, and counted, so a wedged
+            # cleanup shows up in metrics instead of silently delaying
+            # lock handoff.
+            rg.metrics.counter("cleanup_pump_deferred").inc()
+            logger.warning(
+                "session cleanup pump hit its %d-round cap with ops "
+                "still queued; fan-out deferred to the next flush", pump)
         # 6. events (the drive ingested them into rg.events with seq
-        #    dedup): deliver to listeners in order, per-group cursors.
+        #    dedup): deliver to listeners in order, per-group cursors —
+        #    including to sessions this flush closes/expires (the
+        #    deliver-until-close contract), which are popped only after.
         self._deliver_events()
+        for s in leaving:
+            self._sessions.pop(s.id, None)
         return committed
 
     def _deliver_events(self) -> None:

@@ -16,6 +16,7 @@ from typing import Any, ClassVar
 
 from ..io.buffer import BufferInput, BufferOutput
 from ..io.serializer import Serializer, serialize_with
+from ..utils.fields import compile_field_init
 
 # Error codes carried in response.error
 NOT_LEADER = "NOT_LEADER"
@@ -34,13 +35,27 @@ class ProtocolError(Exception):
 
 
 class Message:
-    """Field-list serialization base: subclasses declare ``_fields``."""
+    """Field-list serialization base: subclasses declare ``_fields``.
+
+    Subclasses that declare ``_fields`` without their own ``__init__``
+    get one COMPILED for them (NamedTuple-style): direct attribute
+    assignments instead of a per-field ``kwargs.get`` + ``setattr``
+    loop. Messages are constructed per op on the session hot path, so
+    the generic loop was a measured share of the SPI plane's per-op
+    cost (PERF.md round 6)."""
 
     _fields: ClassVar[tuple[str, ...]] = ()
 
     def __init__(self, **kwargs: Any) -> None:
         for name in self._fields:
             setattr(self, name, kwargs.get(name))
+
+    def __init_subclass__(cls, **kwargs: Any) -> None:
+        super().__init_subclass__(**kwargs)
+        fields = cls.__dict__.get("_fields")
+        if fields is None or "__init__" in cls.__dict__:
+            return
+        compile_field_init(cls, fields)
 
     def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
         for name in self._fields:

@@ -42,9 +42,15 @@ class Resource:
         # (manager layer) so every op is routed to this resource's instance.
         self.client = client
         self._consistency = Consistency.ATOMIC
+        # wire-level consistency strings, cached per facade: the enum
+        # mapping lookups are per-op costs on the submit hot path
+        self._write_cl = self._consistency.write_consistency().value
+        self._read_cl = self._consistency.read_consistency().value
 
     def with_consistency(self, consistency: Consistency) -> "Resource":
         self._consistency = consistency
+        self._write_cl = consistency.write_consistency().value
+        self._read_cl = consistency.read_consistency().value
         return self
 
     @property
@@ -66,11 +72,24 @@ class AbstractResource(Resource):
     async def submit(self, operation: Operation) -> Any:
         if isinstance(operation, Query):
             return await self.client.submit(
-                ResourceQuery(operation, self._consistency.read_consistency().value))
+                ResourceQuery(operation, self._read_cl))
         if isinstance(operation, Command):
             return await self.client.submit(
-                ResourceCommand(operation, self._consistency.write_consistency().value))
+                ResourceCommand(operation, self._write_cl))
         raise TypeError(f"not an operation: {operation!r}")
+
+    def submit_command(self, operation: Operation) -> Any:
+        """Awaitable command submit with the submit chain flattened: when
+        the client exposes the future-returning fast lane
+        (``submit_command_nowait``), the whole facade→instance→client
+        chain runs synchronously and the caller awaits ONE future — the
+        per-op coroutine frames were a measured share of the public SPI
+        plane's per-core ceiling (PERF.md round 6)."""
+        nowait = getattr(self.client, "submit_command_nowait", None)
+        command = ResourceCommand(operation, self._write_cl)
+        if nowait is None:  # custom client shims: keep the coroutine path
+            return self.client.submit(command)
+        return nowait(command)
 
     async def _tracked_listener(self, listeners: Any, callback: Callable,
                                 state: dict, listen_op: Operation,

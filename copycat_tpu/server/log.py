@@ -21,6 +21,7 @@ from typing import Any, Iterator
 
 from ..io.buffer import BufferInput, BufferOutput
 from ..io.serializer import Serializer, serialize_with
+from ..utils.fields import compile_field_init
 
 
 class StorageLevel(enum.Enum):
@@ -167,6 +168,20 @@ class Entry(object):
         for name in self._fields:
             setattr(self, name, kwargs.get(name))
 
+    def __init_subclass__(cls, **kwargs: Any) -> None:
+        # Compiled per-class __init__ (same treatment as protocol
+        # messages): CommandEntry construction is per-op on the leader's
+        # append path, where the generic kwargs loop was measurable.
+        super().__init_subclass__(**kwargs)
+        fields = cls.__dict__.get("_fields")
+        if fields is None or "__init__" in cls.__dict__:
+            return
+        compile_field_init(cls, fields,
+                           head=", term=0, timestamp=0.0",
+                           body_head="    self.index = 0\n"
+                                     "    self.term = term\n"
+                                     "    self.timestamp = timestamp\n")
+
     def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
         buf.write_i64(self.index)
         buf.write_i64(self.term)
@@ -274,6 +289,23 @@ class Log:
         if self._segment_dir is not None:
             self._persist(entry)
         return entry.index
+
+    def append_block(self, entries: list[Entry]) -> int:
+        """Append a run of same-term stamped entries with one index walk
+        (the leader's batched command staging); returns the last index."""
+        if not entries:
+            return self.last_index
+        index = self.last_index
+        store = self._entries
+        for entry in entries:
+            index += 1
+            entry.index = index
+            store.append(entry)
+        self._note_term(entries[0].index, entries[0].term)
+        if self._segment_dir is not None:
+            for entry in entries:
+                self._persist(entry)
+        return index
 
     def append_replicated(self, entry: Entry) -> None:
         """Append an entry at its replicated index, gap-filling compacted

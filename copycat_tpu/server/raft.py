@@ -156,6 +156,10 @@ class RaftServer(Managed):
 
         # apply-side bookkeeping
         self._commit_futures: dict[int, asyncio.Future] = {}  # index -> (result, error)
+        # In-flight event-push tasks (self-removing): the command-batch
+        # fast lane gates its response on these — events-before-response
+        # without per-seq futures.
+        self._event_pushes: set[asyncio.Task] = set()
         self._touched_sessions: set[ServerSession] = set()
         self._applied_event = asyncio.Event()  # pulsed on every apply advance
         # windowed apply (device executor): publishes buffered per entry so
@@ -172,6 +176,14 @@ class RaftServer(Managed):
         self._election_timer: Scheduled | None = None
         self._leader_timer: Scheduled | None = None
         self._closing = False
+
+        # Batched server-side pump (the vector lane): commits whole runs
+        # of device-eligible commands as tensors through ONE engine round
+        # instead of per-op generator chains. Default on; the env knob
+        # exists for the per-op A/B (BENCH_SCENARIOS.md spi table) and as
+        # an escape hatch.
+        self._vector_pump = os.environ.get(
+            "COPYCAT_SERVER_VECTOR_PUMP", "1") != "0"
 
         self._load_meta()
 
@@ -836,6 +848,13 @@ class RaftServer(Managed):
             # after N+1 would silently drop the write.
             if session.next_append_seq == 0:
                 session.next_append_seq = session.command_high + 1
+            if seq < session.next_append_seq:
+                # already appended (a fast-lane block or earlier stage
+                # still in flight): apply resolves the future from the
+                # log; parking it in pending_ops would strand it there
+                # forever (the drain walk never revisits passed seqs)
+                # and re-appending would double-apply
+                return "wait", fut
             session.pending_ops[seq] = operation
             while session.next_append_seq in session.pending_ops:
                 next_seq = session.next_append_seq
@@ -859,8 +878,25 @@ class RaftServer(Managed):
             return msg.CommandBatchResponse(error=msg.UNKNOWN_SESSION)
         session.connection = connection
         session.last_contact = time.monotonic()
+        entries = request.entries or []
+        # FAST LANE: a fresh contiguous seq run with nothing pending
+        # stages as one append block behind ONE commit future — no
+        # per-seq futures, no per-entry dedup dict walks; responses read
+        # back from the session's (replicated) response cache. Anything
+        # irregular — duplicates, seq gaps, ops already in flight — takes
+        # the general per-entry staging below, which shares futures and
+        # serves cached responses (exactly-once unchanged).
+        n = len(entries)
+        if (n and not session.pending_ops and not session.command_futures
+                and entries[0][0] == session.command_high + 1
+                and session.next_append_seq in (0, entries[0][0])
+                # contiguity at C speed: a listcomp + range compare beats
+                # the per-entry Python walk on 1k-op batches
+                and [e[0] for e in entries]
+                == list(range(entries[0][0], entries[0][0] + n))):
+            return await self._command_batch_fast(session, entries)
         staged = [(seq, *self._stage_command(session, seq, op))
-                  for seq, op in (request.entries or [])]
+                  for seq, op in entries]
         entries = []
         for seq, kind, payload in staged:
             if kind == "done":
@@ -893,6 +929,71 @@ class RaftServer(Managed):
                         del session.command_futures[seq]
         return msg.CommandBatchResponse(event_index=session.event_index,
                                         entries=entries)
+
+    async def _command_batch_fast(self, session: ServerSession,
+                                  entries: list) -> msg.CommandBatchResponse:
+        """Stage a fresh contiguous command run as one append block.
+
+        Inlines ``_append``'s per-entry tail (term/timestamp stamp + log
+        append) and pays replication signalling and the single-member
+        deferred commit advance ONCE for the block. The await is a single
+        commit future on the block's LAST index: every earlier entry
+        applies first (in-order apply), so when it resolves the whole
+        run's responses are in the session cache."""
+        term = self.term
+        sid = session.id
+        now = time.time()
+        index = self.log.append_block(
+            [CommandEntry(term, now, sid, seq, op) for seq, op in entries])
+        session.next_append_seq = entries[0][0] + len(entries)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._commit_futures[index] = fut
+        self._signal_replication()
+        if len(self.members) == 1 and not self._advance_scheduled:
+            self._advance_scheduled = True
+            asyncio.get_running_loop().call_soon(self._advance_deferred)
+        try:
+            await fut
+        except msg.ProtocolError as e:
+            if e.code in (msg.NOT_LEADER, msg.NO_LEADER):
+                # same promotion as the general path: the client's
+                # _request loop re-routes and resends the whole batch
+                # (server-side seq dedup makes the resend exactly-once)
+                return msg.CommandBatchResponse(
+                    error=e.code, leader=e.leader, error_detail=e.detail)
+            return msg.CommandBatchResponse(
+                event_index=session.event_index,
+                entries=[(seq, 0, None, e.code, e.detail)
+                         for seq, _ in entries])
+        if self._event_pushes:
+            # Events-before-response (reference Consistency.java:157-176):
+            # the general path gates each LINEARIZABLE response on its
+            # apply's event-push acks inside _complete_command; this lane
+            # has no per-seq futures, so gate the block response on the
+            # pushes outstanding at commit — a superset of the ones this
+            # block's applies spawned — under the same 1 s cap. Empty in
+            # the listener-free steady state, so the fast path pays one
+            # set check.
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*list(self._event_pushes),
+                                   return_exceptions=True), 1.0)
+            except asyncio.TimeoutError:
+                pass
+        responses = session.responses
+        out = []
+        for seq, _ in entries:
+            cached = responses.get(seq)
+            if cached is None:
+                # applied without caching: the session died mid-block
+                out.append((seq, 0, None, msg.UNKNOWN_SESSION,
+                            "session expired before apply"))
+            else:
+                idx, result, error = cached
+                out.append((seq, idx, result,
+                            msg.APPLICATION if error else None, error))
+        return msg.CommandBatchResponse(event_index=session.event_index,
+                                        entries=out)
 
     def _command_response(self, session: ServerSession, index: int,
                           result: Any, error: str | None) -> msg.CommandResponse:
@@ -991,20 +1092,60 @@ class RaftServer(Managed):
 
     def _apply_up_to(self, commit_index: int) -> None:
         window = None
+        route = None
         if self.last_applied < commit_index:
             begin = getattr(self.state_machine, "begin_window", None)
             if begin is not None:
                 window = begin()  # None on the CPU executor
+            if window is not None and self._vector_pump:
+                route = getattr(self.state_machine, "vector_route", None)
+        vrun: list = []  # contiguous run of vector-eligible CommandEntries
+        # Timer deadline for the classify gate, recomputed only after
+        # entries that can (un)schedule timers — the per-entry
+        # ``next_deadline()`` heap peek was a measured share of the
+        # classify walk. A vector run itself never moves it (eligibility
+        # excludes TTL ops, and its tick fires nothing by the gate).
+        deadline = self.executor.next_deadline() if route is not None else None
         try:
             while self.last_applied < commit_index:
                 index = self.last_applied + 1
                 entry = self.log.get(index)
                 self.last_applied = index
-                if entry is not None:
+                if entry is None:
+                    continue
+                if route is not None and type(entry) is CommandEntry:
+                    rec = self._vector_classify(entry, route, deadline)
+                    if rec is not None:
+                        vrun.append(rec)
+                        continue
+                if vrun:
+                    # an ineligible entry bounds the run: commit the
+                    # staged tensors first so log order is preserved.
+                    # vrun is emptied BEFORE the call — if the run
+                    # raises (window barrier timeout), replaying it at
+                    # the next flush point would double-apply. Its
+                    # try is SEPARATE from the bounding entry's: a
+                    # failed run must not swallow the entry's apply
+                    # (last_applied already advanced past it; skipping
+                    # it would hang its commit future and, for a config
+                    # entry, diverge this replica's membership view).
+                    run, vrun = vrun, []
                     try:
-                        self._apply_entry(entry, window)
+                        self._apply_vector_run(run, window)
                     except Exception:
-                        logger.exception("apply failed at index %d", index)
+                        logger.exception(
+                            "vector apply failed before index %d", index)
+                try:
+                    self._apply_entry(entry, window)
+                except Exception:
+                    logger.exception("apply failed at index %d", index)
+                if route is not None:
+                    deadline = self.executor.next_deadline()
+            if vrun:
+                try:
+                    self._apply_vector_run(vrun, window)
+                except Exception:
+                    logger.exception("vector apply failed")
         finally:
             if window is not None:
                 try:
@@ -1012,6 +1153,114 @@ class RaftServer(Managed):
                 except Exception:
                     logger.exception("device window close failed")
         self._applied_event.set()
+
+    # -- batched server-side pump (the vector lane) --------------------
+
+    # The engine's terminal-refusal sentinel (``ops.apply.FAIL``), as a
+    # literal so server/ stays import-independent of the jax-backed ops
+    # package. ``_devint`` excludes INT32_MIN from payloads, so no
+    # legitimate device result ever collides with it.
+    _DEVICE_FAIL = -(2 ** 31)
+
+    def _vector_classify(self, entry: CommandEntry, route: Any,
+                         deadline: float | None):
+        """One staged row for the vector run, or ``None`` for the
+        per-entry path. Eligibility repeats the windowed apply's
+        exactly-once guards (duplicates and dead sessions always take
+        the general path, which serves cached responses) and refuses
+        whenever a state-machine timer would fire within the run (tick
+        order must match the per-entry walk on every replica).
+
+        The ``command_high`` dedup is safe against SAME-seq entries
+        appearing twice in one classify walk because cross-term
+        duplicates (old leader appended, client resent to the new one)
+        are always separated in the log by the new leader's takeover
+        ``NoOpEntry`` (Raft §5.4.2, ``_become_leader``) — an ineligible
+        entry that bounds the run, applying the first instance (and
+        advancing ``command_high``) before the resend is classified.
+        Same-leader duplicates never double-append at all
+        (``_stage_command`` shares the in-flight future).
+        ``deadline`` is the caller's cached ``executor.next_deadline()``
+        (valid for the whole contiguous classify walk)."""
+        session = self.sessions.get(entry.session_id)
+        if session is None or session.state is not SessionState.OPEN:
+            return None
+        seq = entry.seq
+        if seq and (seq <= session.command_high
+                    or (entry.session_id, seq) in self._window_pending_seqs):
+            return None
+        rec = route(entry.operation)
+        if rec is None:
+            return None
+        if deadline is not None \
+                and deadline <= max(self.context.clock, entry.timestamp):
+            return None
+        return (entry, session, *rec)
+
+    def _apply_vector_run(self, run: list, window: Any) -> None:
+        """Apply one run of vector-eligible commands: ONE vectorized
+        ``submit_batch`` + shared engine rounds for the whole run
+        (``DeviceEngine.run_vector``), then per-entry finalization in log
+        order — response cache, commit futures, held-commit bookkeeping —
+        with zero generator/window machinery per op."""
+        if window.busy:
+            window.barrier()  # drain in-flight chains: log order
+        engine = self.state_machine.device_engine
+        n = len(run)
+        groups = [0] * n
+        opc = [0] * n
+        av = [0] * n
+        bv = [0] * n
+        cv = [0] * n
+        for k, (_e, _s, machine, _i, _op, spec) in enumerate(run):
+            groups[k] = machine._group
+            opc[k], av[k], bv[k], cv[k] = spec[0], spec[1], spec[2], spec[3]
+        pump_error: str | None = None
+        raws: list = []
+        try:
+            raws = engine.run_vector(groups, opc, av, bv, cv)
+        except Exception as e:  # liveness failure: fail loudly, not hang
+            logger.exception("vector pump failed; failing %d entries", n)
+            pump_error = str(e)
+        clock = self.context.clock
+        log = self.log
+        futures = self._commit_futures
+        for k, (entry, session, machine, instance, inner, spec) in \
+                enumerate(run):
+            if entry.timestamp > clock:
+                clock = entry.timestamp
+            if pump_error is None and raws[k] == self._DEVICE_FAIL:
+                # the tracked fallback lane can surface the engine's
+                # refusal sentinel (a group emptied by a config change
+                # mid-run); legitimate results never equal it (_devint
+                # excludes INT32_MIN), and handing it to vector_finalize
+                # would record a refused op as a committed result
+                result, error = None, "device refused the operation"
+                log.clean(entry.index)
+            elif pump_error is None:
+                commit = Commit(entry.index, instance.session, clock, inner,
+                                log)
+                try:
+                    result: Any = machine.vector_finalize(
+                        spec[4], inner, raws[k], commit)
+                    error: str | None = None
+                except Exception as e:  # noqa: BLE001 — app errors cross
+                    result, error = None, str(e)
+                    log.clean(entry.index)
+            else:
+                result, error = None, pump_error
+                log.clean(entry.index)
+            seq = entry.seq
+            if seq:
+                session.last_keepalive_time = clock
+                session.cache_response(seq, entry.index, result, error)
+            fut = futures.pop(entry.index, None)
+            if fut is not None and not fut.done():
+                fut.set_result((entry.index, result, error))
+            if seq and session.command_futures:
+                self._complete_command(entry, result, error, [])
+        self.context.clock = clock
+        self.executor.tick(clock)  # no deadline <= clock (classify gate)
 
     def _apply_entry(self, entry: Entry, window: Any = None) -> None:
         if (window is not None and window.busy
@@ -1062,6 +1311,8 @@ class RaftServer(Managed):
                 task = self._push_events(session)
                 if task is not None:
                     pushes.append(task)
+                    self._event_pushes.add(task)
+                    task.add_done_callback(self._event_pushes.discard)
         return pushes
 
     # -- windowed apply (device executor) ------------------------------

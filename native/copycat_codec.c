@@ -41,6 +41,7 @@ static PyObject *g_fields_by_id; /* dict: int -> tuple[str] | None */
 static PyObject *g_encode_body;  /* callable(obj) -> bytes (custom types) */
 static PyObject *g_decode_body;  /* callable(cls, bytes, pos) -> (obj, pos) */
 static PyObject *g_fallback;     /* exception type */
+static PyObject *g_empty_args;   /* cached () for direct tp_new calls */
 
 /* ------------------------------------------------------------------ */
 /* writer                                                              */
@@ -360,12 +361,22 @@ static PyObject *dec_registered(Reader *r, long long tid, int depth) {
         Py_DECREF(res);
         return obj;
     }
-    /* cls.__new__(cls): allocate without running __init__ (the generic
-     * field-list read path, like serializer.py read_object) */
-    PyObject *newf = PyObject_GetAttrString(cls, "__new__");
-    if (!newf) return NULL;
-    PyObject *obj = PyObject_CallOneArg(newf, cls);
-    Py_DECREF(newf);
+    /* Allocate without running __init__ (the generic field-list read
+     * path, like serializer.py read_object). tp_new with empty args is
+     * exactly what cls.__new__(cls) resolves to for these plain classes
+     * — calling the slot directly skips the per-object attribute lookup
+     * and bound-staticmethod allocation (measured on 1k-op batch
+     * decodes). Classes overriding __new__ still go through their slot. */
+    PyObject *obj;
+    newfunc tp_new = ((PyTypeObject *)cls)->tp_new;
+    if (tp_new) {
+        obj = tp_new((PyTypeObject *)cls, g_empty_args, NULL);
+    } else {
+        PyObject *newf = PyObject_GetAttrString(cls, "__new__");
+        if (!newf) return NULL;
+        obj = PyObject_CallOneArg(newf, cls);
+        Py_DECREF(newf);
+    }
     if (!obj) return NULL;
     Py_ssize_t nf = PyTuple_GET_SIZE(fields);
     for (Py_ssize_t i = 0; i < nf; i++) {
@@ -532,6 +543,103 @@ static PyObject *codec_decode(PyObject *self, PyObject *data) {
     return obj;
 }
 
+/* ------------------------------------------------------------------ */
+/* frame-burst walk: [u32 len][u8 kind][u64 corr][payload]...           */
+/* The shared TCP wire framing (io/tcp.py _HEADER = ">IBQ") walked in   */
+/* one call per read burst: the transports hand whole read buffers to   */
+/* decode_frames and whole response bursts to encode_frames, so the    */
+/* session frame walk — batch envelope in, per-op decode, response     */
+/* re-encode — stays in C for the full request/response cycle.         */
+
+#define FRAME_HEADER 13
+
+static PyObject *codec_decode_frames(PyObject *self, PyObject *data) {
+    (void)self;
+    /* buffer protocol, not PyBytes: the TCP read loop accumulates into
+       a bytearray (amortized O(n) appends); every decoded object copies
+       out of the buffer, so nothing references it after the call */
+    Py_buffer view;
+    if (PyObject_GetBuffer(data, &view, PyBUF_SIMPLE) != 0) {
+        return NULL;
+    }
+    const unsigned char *buf = (const unsigned char *)view.buf;
+    Py_ssize_t total = view.len;
+    PyObject *out = PyList_New(0);
+    if (!out) { PyBuffer_Release(&view); return NULL; }
+    Py_ssize_t pos = 0;
+    while (pos + FRAME_HEADER <= total) {
+        unsigned long long length = 0, corr = 0;
+        for (int i = 0; i < 4; i++) length = (length << 8) | buf[pos + i];
+        unsigned char kind = buf[pos + 4];
+        for (int i = 0; i < 8; i++) corr = (corr << 8) | buf[pos + 5 + i];
+        if (pos + FRAME_HEADER + (Py_ssize_t)length > total) break;
+        Reader r = {buf, pos + FRAME_HEADER + (Py_ssize_t)length,
+                    pos + FRAME_HEADER, data};
+        PyObject *obj = dec(&r, 0);
+        if (!obj) { /* incl. Fallback: the caller re-walks this burst
+                       frame-by-frame in Python */
+            Py_DECREF(out); PyBuffer_Release(&view); return NULL;
+        }
+        if (r.pos != r.len) {
+            Py_DECREF(obj); Py_DECREF(out);
+            PyErr_Format(g_fallback, "frame decode left %zd trailing bytes",
+                         r.len - r.pos);
+            PyBuffer_Release(&view);
+            return NULL;
+        }
+        PyObject *rec = Py_BuildValue("(iKN)", (int)kind, corr, obj);
+        if (!rec || PyList_Append(out, rec) < 0) {
+            Py_XDECREF(rec); Py_DECREF(out);
+            PyBuffer_Release(&view);
+            return NULL;
+        }
+        Py_DECREF(rec);
+        pos += FRAME_HEADER + (Py_ssize_t)length;
+    }
+    PyBuffer_Release(&view);
+    return Py_BuildValue("(Nn)", out, pos);
+}
+
+static PyObject *codec_encode_frames(PyObject *self, PyObject *frames) {
+    (void)self;
+    PyObject *fast = PySequence_Fast(frames,
+                                     "encode_frames() needs a sequence");
+    if (!fast) return NULL;
+    Writer w = {NULL, 0, 0};
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(fast, i);
+        int kind;
+        unsigned long long corr;
+        PyObject *obj;
+        if (!PyArg_ParseTuple(item, "iKO", &kind, &corr, &obj)) {
+            Py_DECREF(fast); PyMem_Free(w.buf);
+            return NULL;
+        }
+        Py_ssize_t hdr = w.len;
+        if (w_reserve(&w, FRAME_HEADER) < 0) {
+            Py_DECREF(fast); PyMem_Free(w.buf);
+            return NULL;
+        }
+        w.len += FRAME_HEADER;
+        if (enc(obj, &w, 0) < 0) {
+            Py_DECREF(fast); PyMem_Free(w.buf);
+            return NULL;
+        }
+        unsigned long long length = (unsigned long long)(w.len - hdr
+                                                         - FRAME_HEADER);
+        for (int b = 0; b < 4; b++)
+            w.buf[hdr + b] = (unsigned char)(length >> (24 - 8 * b));
+        w.buf[hdr + 4] = (unsigned char)kind;
+        for (int b = 0; b < 8; b++)
+            w.buf[hdr + 5 + b] = (unsigned char)(corr >> (56 - 8 * b));
+    }
+    Py_DECREF(fast);
+    PyObject *out = PyBytes_FromStringAndSize((char *)w.buf, w.len);
+    PyMem_Free(w.buf);
+    return out;
+}
+
 static PyObject *codec_configure(PyObject *self, PyObject *args) {
     (void)self;
     PyObject *ibt, *tbi, *fbi, *eb, *db;
@@ -551,6 +659,12 @@ static PyMethodDef codec_methods[] = {
      "decode_body) — bind the live registries + fallback hooks."},
     {"encode", codec_encode, METH_O, "encode(obj) -> bytes"},
     {"decode", codec_decode, METH_O, "decode(bytes) -> obj"},
+    {"decode_frames", codec_decode_frames, METH_O,
+     "decode_frames(bytes) -> ([(kind, corr, obj), ...], consumed) — walk "
+     "complete [u32 len][u8 kind][u64 corr][payload] frames in one call."},
+    {"encode_frames", codec_encode_frames, METH_O,
+     "encode_frames([(kind, corr, obj), ...]) -> bytes — one framed "
+     "buffer for a whole response burst."},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef codec_module = {
@@ -561,6 +675,11 @@ static struct PyModuleDef codec_module = {
 PyMODINIT_FUNC PyInit_copycat_codec(void) {
     PyObject *m = PyModule_Create(&codec_module);
     if (!m) return NULL;
+    g_empty_args = PyTuple_New(0);
+    if (!g_empty_args) {
+        Py_DECREF(m);
+        return NULL;
+    }
     g_fallback = PyErr_NewException("copycat_codec.Fallback", NULL, NULL);
     if (!g_fallback || PyModule_AddObject(m, "Fallback", g_fallback) < 0) {
         Py_XDECREF(g_fallback);

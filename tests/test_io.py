@@ -196,6 +196,35 @@ async def test_tcp_transport_roundtrip():
 
 
 @async_test
+async def test_tcp_transport_roundtrip_pure_python_walk(monkeypatch):
+    """The TCP burst walk must work identically WITHOUT the native
+    codec (toolchain-less deployments): force codec() to None so both
+    the frame walk and the write path take the Python struct lane."""
+    from copycat_tpu.io import tcp as tcp_mod
+    monkeypatch.setattr(tcp_mod, "codec", lambda: None)
+    transport = TcpTransport()
+    server = transport.server()
+    address = Address("127.0.0.1", 18767)
+
+    def on_connect(conn):
+        async def double(msg):
+            return [msg, msg]
+
+        conn.handler(int, double)
+        conn.handler(str, double)
+
+    await server.listen(address, on_connect)
+    conn = await transport.client().connect(address)
+    # a burst of concurrent requests lands as one multi-frame read
+    import asyncio
+    results = await asyncio.gather(*(conn.send(i) for i in range(16)),
+                                   conn.send("s"))
+    assert results == [[i, i] for i in range(16)] + [["s", "s"]]
+    await conn.close()
+    await server.close()
+
+
+@async_test
 async def test_tcp_transport_error_marshalling():
     transport = TcpTransport()
     server = transport.server()

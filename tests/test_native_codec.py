@@ -225,6 +225,93 @@ def test_fuzz_random_graphs_roundtrip_both_paths():
         assert _py_write(C.decode(wire)) == wire, repr(obj)[:80]
 
 
+# ---------------------------------------------------------------------------
+# frame-burst walk (decode_frames / encode_frames): the TCP wire framing
+# [u32 len][u8 kind][u64 corr][payload] walked in one C call per read
+# burst — must match io/tcp.py's Python struct walk byte-for-byte.
+
+import struct  # noqa: E402
+
+_FRAME = struct.Struct(">IBQ")
+
+
+def _py_frame(kind: int, corr: int, obj) -> bytes:
+    payload = _py_write(obj)
+    return _FRAME.pack(len(payload), kind, corr) + payload
+
+
+def test_encode_frames_byte_identical_to_python_framing():
+    burst = [(0, 1, mo.InstanceCommand(1, ac.Get())),
+             (1, 2, [1, "two", None]),
+             (2, 2**40, "TypeError: boom")]
+    assert C.encode_frames(burst) == b"".join(
+        _py_frame(k, co, o) for k, co, o in burst)
+
+
+def test_decode_frames_walks_whole_burst():
+    burst = [(0, i, mo.InstanceCommand(i, ac.Set(value=i, ttl=None)))
+             for i in range(20)]
+    wire = C.encode_frames(burst)
+    frames, consumed = C.decode_frames(wire)
+    assert consumed == len(wire)
+    assert [(k, co) for k, co, _ in frames] == [(0, i) for i in range(20)]
+    for (_, _, got), (_, _, sent) in zip(frames, burst):
+        assert _py_write(got) == _py_write(sent)
+
+
+def test_decode_frames_stops_at_torn_frame():
+    whole = _py_frame(1, 7, "complete")
+    torn = _py_frame(0, 8, ["partial", "frame"])
+    for cut in range(1, len(torn)):
+        frames, consumed = C.decode_frames(whole + torn[:cut])
+        assert consumed == len(whole)
+        assert len(frames) == 1 and frames[0][:2] == (1, 7)
+
+
+def test_decode_frames_inexpressible_payload_raises_fallback():
+    # a >64-bit int inside one frame aborts the WHOLE burst with
+    # Fallback — io/tcp.py then re-walks it frame-by-frame in Python
+    wire = _py_frame(1, 1, 1) + _py_frame(1, 2, 2**70)
+    with pytest.raises(C.Fallback):
+        C.decode_frames(wire)
+
+
+def test_fuzz_decode_frames_garbage_never_crashes():
+    import random
+
+    rng = random.Random(0xF4A3E)
+    real = C.encode_frames([(0, 5, mo.InstanceCommand(5, ac.Get()))])
+    for trial in range(2000):
+        if rng.random() < 0.5:
+            data = bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(0, 48)))
+        else:  # bit-flipped real frames: valid headers, corrupt payloads
+            data = bytearray(real)
+            for _ in range(rng.randrange(1, 4)):
+                data[rng.randrange(len(data))] ^= 1 << rng.randrange(8)
+            data = bytes(data)
+        try:
+            C.decode_frames(data)
+        except Exception:
+            pass  # any Python-level failure is fine; crashing is not
+
+
+def test_frame_walk_fuzz_roundtrip_random_bursts():
+    import random
+
+    rng = random.Random(31)
+    for trial in range(100):
+        burst = [(rng.randrange(3), rng.randrange(2**63),
+                  _random_graph(rng)) for _ in range(rng.randrange(1, 8))]
+        wire = C.encode_frames(burst)
+        assert wire == b"".join(_py_frame(*f) for f in burst)
+        frames, consumed = C.decode_frames(wire)
+        assert consumed == len(wire) and len(frames) == len(burst)
+        for (k, co, got), (k0, co0, sent) in zip(frames, burst):
+            assert (k, co) == (k0, co0)
+            assert _py_write(got) == _py_write(sent)
+
+
 def test_deep_nesting_falls_back_never_segfaults():
     """Unbounded recursion in the C walkers was a crash vector (found by
     fuzzing: 200k-deep nesting segfaulted; crafted deep WIRE bytes could
