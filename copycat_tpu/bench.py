@@ -33,6 +33,7 @@ Scenarios (``COPYCAT_BENCH_SCENARIO``, BASELINE.md benchmark configs):
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -138,6 +139,12 @@ PROFILE_DIR = os.environ.get("COPYCAT_BENCH_PROFILE", "")
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+#: per-run registry snapshots scenarios contribute to the
+#: ``--metrics-json`` artifact (run_spi adds the server's full
+#: stats_snapshot + the client registry), keyed by component name.
+METRICS_SNAPSHOTS: dict = {}
 
 
 def percentiles(hist: np.ndarray, qs) -> list[int]:
@@ -469,6 +476,7 @@ def run_host() -> dict:
         lat = rg.metrics.histogram("commit_latency_rounds")
         out["p50_commit_latency_rounds"] = lat.percentile(50)
         out["p99_commit_latency_rounds"] = lat.percentile(99)
+    METRICS_SNAPSHOTS["driver"] = rg.metrics.snapshot()
     return out
 
 
@@ -532,6 +540,7 @@ def run_session() -> dict:
     client.flush()
     expect = per_group * (len(reps) + 1)
     assert s0.result(q) == expect, (s0.result(q), expect)
+    METRICS_SNAPSHOTS["driver"] = rg.metrics.snapshot()
     return {
         "metric": f"session_committed_ops_per_sec_{GROUPS}_groups",
         "value": round(best, 1),
@@ -706,6 +715,10 @@ def run_spi() -> dict:
                     f"-> {ops:,.0f} client-visible ops/sec")
             lat = np.asarray(sorted(best_lats))
             rounds0 = engine._groups.rounds if engine._groups else 0
+            # --metrics-json artifact: every bench run leaves an
+            # attributable snapshot (server lanes + transport + client)
+            METRICS_SNAPSHOTS["server"] = server.server.stats_snapshot()
+            METRICS_SNAPSHOTS["client"] = client.client.metrics.snapshot()
             return {
                 "metric": (f"spi_client_visible_ops_per_sec_{instances}"
                            f"_device_instances"
@@ -944,6 +957,12 @@ def run_host_read() -> dict:
 
 
 def main() -> None:
+    parser = argparse.ArgumentParser(prog="copycat-bench")
+    parser.add_argument(
+        "--metrics-json", default=None, metavar="PATH",
+        help="write the result plus per-component metrics snapshots "
+             "(server/transport/client registries) as one JSON artifact")
+    args, _ = parser.parse_known_args()
     # fail fast (exit 2) when the tunneled accelerator is unreachable —
     # a dead tunnel otherwise hangs device enumeration forever
     from .utils.platform import enable_compilation_cache, require_devices
@@ -967,6 +986,11 @@ def main() -> None:
         raise SystemExit(
             f"unknown scenario {SCENARIO!r}; pick one of "
             f"{['election', 'map_read', 'host', 'host_read', 'spi', 'session', *SUBMIT_BUILDERS]}")
+    if args.metrics_json:
+        with open(args.metrics_json, "w") as f:
+            json.dump({**result, "scenario": SCENARIO,
+                       "metrics": METRICS_SNAPSHOTS}, f)
+        log(f"bench: metrics snapshot written to {args.metrics_json}")
     print(json.dumps(result))
 
 

@@ -3,40 +3,162 @@
 ``copycat-server`` runs a standalone AtomixServer node — the packaged
 equivalent of the reference's standalone-server example
 (``StandaloneServerExample.java:27``); the runnable example in
-``examples/standalone_server.py`` delegates here.
+``examples/standalone_server.py`` delegates here. ``copycat-tpu`` is the
+operator multi-tool: ``copycat-tpu stats <host:port>`` reads a running
+server's stats listener (enable with ``copycat-server --stats-port N``
+or ``AtomixServer(..., stats_port=N)``; docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
 
+import argparse
 import asyncio
+import contextlib
+import json
+import shutil
+import signal
 import sys
 import tempfile
 
 
-async def _serve(argv: list[str]) -> None:
+def _server_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="copycat-server",
+        description="Run a standalone copycat-tpu server node.")
+    parser.add_argument("members", nargs="*", default=["127.0.0.1:5001"],
+                        metavar="host:port",
+                        help="this node's address, then its peers "
+                             "(default 127.0.0.1:5001)")
+    parser.add_argument("--stats-port", type=int, default=None,
+                        metavar="PORT",
+                        help="serve /stats (JSON), /metrics (Prometheus) "
+                             "and /traces on this port (0 = ephemeral)")
+    parser.add_argument("--stats-host", default="127.0.0.1", metavar="HOST",
+                        help="stats listener bind host (default loopback; "
+                             "the surface is unauthenticated — widen "
+                             "deliberately)")
+    parser.add_argument("--log-dir", default=None, metavar="DIR",
+                        help="Raft log directory (default: a temp dir, "
+                             "removed on exit)")
+    return parser
+
+
+async def _serve(args: argparse.Namespace) -> None:
     from .io.tcp import TcpTransport
     from .io.transport import Address
     from .manager.atomix import AtomixServer
     from .server.log import Storage, StorageLevel
 
-    args = argv or ["127.0.0.1:5001"]
-    address = Address.parse(args[0])
-    members = [Address.parse(a) for a in args]
+    members = args.members or ["127.0.0.1:5001"]
+    address = Address.parse(members[0])
+    member_addrs = [Address.parse(a) for a in members]
 
-    storage = Storage(StorageLevel.DISK,
-                      directory=tempfile.mkdtemp(prefix="copycat-tpu-"),
+    # An explicit --log-dir is the operator's to keep; the temp-dir
+    # default is ours to remove on exit (it used to leak one
+    # copycat-tpu-* dir per run).
+    log_dir = args.log_dir or tempfile.mkdtemp(prefix="copycat-tpu-")
+    own_log_dir = args.log_dir is None
+    storage = Storage(StorageLevel.DISK, directory=log_dir,
                       max_entries_per_segment=16)
-    server = (AtomixServer.builder(address, members)
-              .with_transport(TcpTransport())
-              .with_storage(storage)
-              .build())
-    await server.open()
-    print(f"server listening at {address} (log: {storage.directory})")
+    builder = (AtomixServer.builder(address, member_addrs)
+               .with_transport(TcpTransport())
+               .with_storage(storage))
+    if args.stats_port is not None:
+        builder = builder.with_stats_port(args.stats_port, args.stats_host)
+    server = builder.build()
 
-    while True:
-        await asyncio.sleep(10)
+    # Graceful shutdown: SIGINT/SIGTERM close the node (stats listener,
+    # transport, log) instead of dying mid-write with the temp dir
+    # leaked; a second SIGINT still kills the process the hard way.
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+
+    def _on_signal() -> None:
+        stop.set()
+        # restore default handling so a SECOND signal kills the process
+        # the hard way instead of re-setting an already-set event while
+        # a wedged close() burns its timeout
+        for s in (signal.SIGINT, signal.SIGTERM):
+            with contextlib.suppress(Exception):
+                loop.remove_signal_handler(s)
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        with contextlib.suppress(NotImplementedError, ValueError):
+            loop.add_signal_handler(sig, _on_signal)
+
+    try:
+        # inside the try: a failed open (port taken, bad stats bind)
+        # must still remove the temp log dir below
+        await server.open()
+        print(f"server listening at {address} (log: {log_dir})", flush=True)
+        if server.stats is not None:
+            print(f"stats listener on port {server.stats.port} "
+                  f"(/stats /metrics /traces)", flush=True)
+        await stop.wait()
+        print("shutting down...", flush=True)
+    finally:
+        try:
+            await asyncio.wait_for(server.close(), 10)
+        except (Exception, asyncio.TimeoutError):
+            pass
+        if own_log_dir:
+            shutil.rmtree(log_dir, ignore_errors=True)
 
 
 def server(argv: list[str] | None = None) -> None:
-    """``copycat-server host:port [peers...]``"""
-    asyncio.run(_serve(sys.argv[1:] if argv is None else argv))
+    """``copycat-server host:port [peers...] [--stats-port N]``"""
+    args = _server_parser().parse_args(
+        sys.argv[1:] if argv is None else argv)
+    try:
+        asyncio.run(_serve(args))
+    except KeyboardInterrupt:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# copycat-tpu: the operator multi-tool
+# ---------------------------------------------------------------------------
+
+
+def _stats(args: argparse.Namespace) -> int:
+    from .server.stats import fetch_stats
+
+    path = {"stats": "/stats", "metrics": "/metrics",
+            "traces": "/traces.txt"}[args.what]
+    try:
+        body = asyncio.run(fetch_stats(args.address, path))
+    except (OSError, RuntimeError, asyncio.TimeoutError) as e:
+        print(f"copycat-tpu stats: cannot read {args.address}{path}: {e}\n"
+              f"(is the server running with --stats-port?)",
+              file=sys.stderr)
+        return 1
+    if args.what in ("metrics", "traces"):
+        print(body.decode(), end="")
+    else:
+        print(json.dumps(json.loads(body), indent=2, sort_keys=True))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> None:
+    """``copycat-tpu <verb>``: ``stats <host:port>`` reads a running
+    server's observability surface; ``serve`` is ``copycat-server``."""
+    parser = argparse.ArgumentParser(prog="copycat-tpu")
+    sub = parser.add_subparsers(dest="verb", required=True)
+
+    stats = sub.add_parser(
+        "stats", help="read a running server's stats listener")
+    stats.add_argument("address", metavar="host:port",
+                       help="the server's --stats-port endpoint")
+    stats.add_argument("--what", choices=("stats", "metrics", "traces"),
+                       default="stats",
+                       help="stats = JSON snapshot (default), metrics = "
+                            "Prometheus text, traces = slowest requests")
+
+    serve = sub.add_parser("serve", help="run a standalone server node")
+    serve.add_argument("rest", nargs=argparse.REMAINDER)
+
+    args = parser.parse_args(sys.argv[1:] if argv is None else argv)
+    if args.verb == "stats":
+        raise SystemExit(_stats(args))
+    if args.verb == "serve":
+        server(args.rest)

@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import random
+import time
 import uuid
 from typing import Any, Callable
 
@@ -22,8 +23,10 @@ from ..protocol import messages as msg
 from ..protocol.operations import Command, Operation, Query
 from ..utils.listeners import Listener, Listeners
 from ..utils.managed import Managed
+from ..utils.metrics import MetricsRegistry
 from ..utils.scheduled import Scheduled
 from ..utils.tasks import spawn
+from ..utils.tracing import TRACER
 
 _client_counter = itertools.count()
 
@@ -133,6 +136,11 @@ class RaftClient(Managed):
         self.session_timeout = session_timeout
         self.strategy = connection_strategy or AnyConnectionStrategy()
         self.client_id = f"client-{uuid.uuid4().hex[:8]}-{next(_client_counter)}"
+        # Observability: submit->response latency, retry/re-route and
+        # indeterminate-outcome counters (docs/OBSERVABILITY.md). The
+        # hot path pays one counter add and, per flushed batch, one
+        # histogram record.
+        self.metrics = MetricsRegistry()
 
         self._client = transport.client()
         self._loop: asyncio.AbstractEventLoop | None = None  # pinned at open
@@ -240,6 +248,7 @@ class RaftClient(Managed):
                 response = await asyncio.wait_for(conn.send(request), tmo)
             except (TransportError, OSError, asyncio.TimeoutError) as e:
                 last = e
+                self.metrics.counter("client_retries").inc()
                 # A hinted leader that failed the attempt gets no second
                 # pin: _connect prefers the hint, so keeping it after a
                 # timeout re-dialed the SAME stuck server every retry —
@@ -255,6 +264,7 @@ class RaftClient(Managed):
                 continue
             error = getattr(response, "error", None)
             if error in (msg.NOT_LEADER, msg.NO_LEADER):
+                self.metrics.counter("client_reroutes").inc()
                 self._leader_hint = getattr(response, "leader", None)
                 members = getattr(response, "members", None)
                 if members:
@@ -348,34 +358,60 @@ class RaftClient(Managed):
         if batch:
             spawn(self._flush_batch(batch), name="command-batch")
 
+    def _submit_done(self, t0: float, n: int, trace: int | None) -> None:
+        """Per-request latency bookkeeping: one histogram sample per wire
+        request (every command in a batch experienced that latency), one
+        ``client.submit`` span when tracing."""
+        end = time.perf_counter()
+        self.metrics.histogram("submit_latency_ms").record((end - t0) * 1e3)
+        if trace is not None:
+            TRACER.span(trace, "client.submit", t0, end, n=n)
+
+    def _submit_failed(self, e: BaseException, n: int) -> None:
+        """A submit whose outcome is UNKNOWN is INDETERMINATE — the
+        reference's session-loss command failure. That is exactly the
+        routing-exhaustion ProtocolError from ``_request`` (per-attempt
+        timeouts are retried internally and surface as NO_LEADER; the
+        command may have been appended by a leader we lost)."""
+        if isinstance(e, msg.ProtocolError) \
+                and e.code in (msg.NO_LEADER, msg.NOT_LEADER):
+            self.metrics.counter("commands_indeterminate").inc(n)
+
     async def _flush_batch(self, batch: list) -> None:
+        self.metrics.counter("commands_submitted").inc(len(batch))
+        trace = TRACER.new_trace() if TRACER.enabled else None
+        t0 = time.perf_counter()
         if len(batch) == 1:
             seq, operation, fut = batch[0]
             try:
                 response = await self._request(msg.CommandRequest(
                     session_id=self._session.id, seq=seq,
-                    operation=operation))
+                    operation=operation, trace=trace))
                 result = self._finish(response, seq)
             except BaseException as e:  # noqa: BLE001 — delivered via fut
+                self._submit_failed(e, 1)
                 if not fut.done():
                     fut.set_exception(e)
                 return
+            self._submit_done(t0, 1, trace)
             if not fut.done():
                 fut.set_result(result)
             return
         try:
             response = await self._request(msg.CommandBatchRequest(
                 session_id=self._session.id,
-                entries=[(seq, op) for seq, op, _ in batch]))
+                entries=[(seq, op) for seq, op, _ in batch], trace=trace))
             # batch-level fatal (UNKNOWN_SESSION etc.): _finish raises
             # the right exception type for every entry
             if getattr(response, "error", None):
                 self._finish(response, None)
         except BaseException as e:  # noqa: BLE001
+            self._submit_failed(e, len(batch))
             for _, _, fut in batch:
                 if not fut.done():
                     fut.set_exception(e)
             return
+        self._submit_done(t0, len(batch), trace)
         resp_entries = response.entries or []
         # positional fast path: the server answers in request order, so
         # the common case correlates by zip — the by-seq dict is built
@@ -437,6 +473,7 @@ class RaftClient(Managed):
     async def _submit_query(self, operation: Query) -> Any:
         if not self._session.is_open:
             raise SessionExpiredError("session is not open")
+        self.metrics.counter("queries_submitted").inc()
         consistency = operation.consistency().value
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()

@@ -13,6 +13,7 @@ import asyncio
 import random
 from typing import Any, Callable, Iterable
 
+from ..utils.metrics import MetricsRegistry
 from .serializer import Serializer
 from .transport import (
     Address,
@@ -169,13 +170,19 @@ class LocalConnection(Connection):
     def __init__(self, serializer: Serializer,
                  registry: "LocalServerRegistry | None" = None,
                  local_address: Address | None = None,
-                 remote_address: Address | None = None) -> None:
+                 remote_address: Address | None = None,
+                 metrics: MetricsRegistry | None = None) -> None:
         super().__init__()
         self._serializer = serializer
         self._registry = registry
         self.local_address = local_address
         self.remote_address = remote_address
         self.peer: "LocalConnection | None" = None
+        m = metrics if metrics is not None else MetricsRegistry()
+        self._m_bytes_out = m.counter("bytes_out")
+        self._m_frames_out = m.counter("frames_out")
+        self._m_bytes_in = m.counter("bytes_in")
+        self._m_frames_in = m.counter("frames_in")
 
     async def send(self, message: Any) -> Any:
         peer = self.peer
@@ -192,6 +199,10 @@ class LocalConnection(Connection):
                     f"{self.remote_address} dropped")
         # Round-trip through the wire format for fidelity with real transports.
         wire = self._serializer.write(message)
+        self._m_frames_out.inc()
+        self._m_bytes_out.inc(len(wire))
+        peer._m_frames_in.inc()
+        peer._m_bytes_in.inc(len(wire))
         delivered = peer._serializer.read(wire)
         try:
             result = await peer._handle(delivered)
@@ -220,7 +231,15 @@ class LocalConnection(Connection):
             nem.delivered += 1
         if result is None:
             return None
-        return self._serializer.read(peer._serializer.write(result))
+        # response leg: the peer SENDS, we receive — counted like the
+        # request leg so cross-transport attribution (local vs tcp in
+        # the spi bench) compares like with like
+        wire = peer._serializer.write(result)
+        peer._m_frames_out.inc()
+        peer._m_bytes_out.inc(len(wire))
+        self._m_frames_in.inc()
+        self._m_bytes_in.inc(len(wire))
+        return self._serializer.read(wire)
 
     async def close(self) -> None:
         peer = self.peer
@@ -231,10 +250,12 @@ class LocalConnection(Connection):
 
 class LocalClient(Client):
     def __init__(self, registry: LocalServerRegistry, serializer: Serializer,
-                 local_address: Address | None = None) -> None:
+                 local_address: Address | None = None,
+                 metrics: MetricsRegistry | None = None) -> None:
         self._registry = registry
         self._serializer = serializer
         self._local_address = local_address
+        self._metrics = metrics
         self._connections: list[LocalConnection] = []
 
     async def connect(self, address: Address) -> Connection:
@@ -245,10 +266,13 @@ class LocalClient(Client):
         if nem is not None and not nem.allowed(self._local_address, address):
             raise TransportError(
                 f"nemesis: dial {self._local_address} -> {address} blocked")
+        if self._metrics is not None:
+            self._metrics.counter("connects").inc()
         local = LocalConnection(self._serializer, self._registry,
-                                self._local_address, address)
+                                self._local_address, address, self._metrics)
         remote = LocalConnection(server._serializer, self._registry,
-                                 address, self._local_address)
+                                 address, self._local_address,
+                                 server._metrics)
         local.peer = remote
         remote.peer = local
         self._connections.append(local)
@@ -265,9 +289,11 @@ class LocalClient(Client):
 
 
 class LocalServer(Server):
-    def __init__(self, registry: LocalServerRegistry, serializer: Serializer) -> None:
+    def __init__(self, registry: LocalServerRegistry, serializer: Serializer,
+                 metrics: MetricsRegistry | None = None) -> None:
         self._registry = registry
         self._serializer = serializer
+        self._metrics = metrics
         self._address: Address | None = None
         self._on_connect: Callable[[Connection], None] | None = None
         self._connections: list[LocalConnection] = []
@@ -306,10 +332,12 @@ class LocalTransport(Transport):
         # they listen on; anonymous transports (no local_address) reach
         # every side of a partition — the Jepsen client model.
         self._local_address = local_address
+        #: shared by every endpoint this transport hands out
+        self.metrics = MetricsRegistry()
 
     def client(self) -> Client:
         return LocalClient(self._registry, Serializer(),
-                           self._local_address)
+                           self._local_address, self.metrics)
 
     def server(self) -> Server:
-        return LocalServer(self._registry, Serializer())
+        return LocalServer(self._registry, Serializer(), self.metrics)

@@ -24,6 +24,7 @@ import subprocess
 import threading
 from typing import Any, Callable
 
+from ..utils.metrics import MetricsRegistry
 from .serializer import Serializer
 from .transport import (
     Address,
@@ -87,10 +88,17 @@ def native_available() -> bool:
 class _NativeLoop:
     """Owns one C++ epoll loop + the Python-side poller thread."""
 
-    def __init__(self) -> None:
+    def __init__(self, metrics: MetricsRegistry | None = None) -> None:
         lib = _load()
         if lib is None:
             raise TransportError(f"native transport unavailable: {_lib_err}")
+        m = metrics if metrics is not None else MetricsRegistry()
+        self.metrics = m
+        self._m_bytes_in = m.counter("bytes_in")
+        self._m_bytes_out = m.counter("bytes_out")
+        self._m_frames_in = m.counter("frames_in")
+        self._m_frames_out = m.counter("frames_out")
+        self._m_burst = m.histogram("read_burst_frames")
         self._lib = lib
         self._handle = ctypes.c_void_p(lib.cn_new())
         if lib.cn_start(self._handle) != 0:
@@ -153,6 +161,13 @@ class _NativeLoop:
             self._dispatch_burst(burst)
 
     def _dispatch_burst(self, burst: list) -> None:
+        # poller-thread-only counters (the asyncio side owns the _out
+        # pair, so no counter is shared across threads)
+        frames = [p for _, etype, _, _, p in burst if etype == _ETYPE_FRAME]
+        if frames:
+            self._m_frames_in.inc(len(frames))
+            self._m_burst.record(len(frames))
+            self._m_bytes_in.inc(sum(len(p) for p in frames))
         aio = self._aio
         if aio is None or aio.is_closed():
             return
@@ -205,6 +220,8 @@ class _NativeLoop:
         if self._lib.cn_send(self._handle, conn, kind, corr, payload,
                              len(payload)) != 0:
             raise ConnectionClosedError("connection closed")
+        self._m_frames_out.inc()
+        self._m_bytes_out.inc(len(payload))
 
     def close_conn(self, conn: int) -> None:
         self._lib.cn_close_conn(self._handle, conn)
@@ -303,6 +320,7 @@ class NativeTcpClient(Client):
     async def connect(self, address: Address) -> Connection:
         aio = asyncio.get_running_loop()
         self._loop.bind_asyncio(aio)
+        self._loop.metrics.counter("connects").inc()
         # Resolve on the asyncio resolver (thread pool) so a slow DNS
         # lookup never blocks the event loop; C gets a numeric host.
         import socket
@@ -347,6 +365,7 @@ class NativeTcpServer(Server):
         self._listener = self._loop.listen(address)
 
         def accept(fd: int) -> None:
+            self._loop.metrics.counter("accepts").inc()
             conn = NativeConnection(self._loop, fd, Serializer())
             self._connections.append(conn)
             conn.on_close(lambda c: self._connections.remove(c)
@@ -369,7 +388,8 @@ class NativeTcpTransport(Transport):
     """Drop-in for ``TcpTransport`` with the I/O path in C++."""
 
     def __init__(self) -> None:
-        self._loop = _NativeLoop()
+        self.metrics = MetricsRegistry()
+        self._loop = _NativeLoop(self.metrics)
 
     def client(self) -> Client:
         return NativeTcpClient(self._loop)

@@ -24,6 +24,7 @@ import asyncio
 import struct
 from typing import Any, Callable
 
+from ..utils.metrics import MetricsRegistry
 from .codec import codec
 from .serializer import Serializer
 from .transport import (
@@ -42,7 +43,8 @@ _REQUEST, _RESPONSE, _ERROR = 0, 1, 2
 
 class TcpConnection(Connection):
     def __init__(
-        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter, serializer: Serializer
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+        serializer: Serializer, metrics: MetricsRegistry | None = None,
     ) -> None:
         super().__init__()
         self._reader = reader
@@ -50,6 +52,15 @@ class TcpConnection(Connection):
         self._serializer = serializer
         self._next_id = 0
         self._pending: dict[int, asyncio.Future] = {}
+        # Transport-shared registry (TcpTransport.metrics); the counter
+        # objects are cached so the read/write loops pay one attr + int
+        # add per event, never a registry lookup.
+        m = metrics if metrics is not None else MetricsRegistry()
+        self._m_bytes_in = m.counter("bytes_in")
+        self._m_bytes_out = m.counter("bytes_out")
+        self._m_frames_in = m.counter("frames_in")
+        self._m_frames_out = m.counter("frames_out")
+        self._m_burst = m.histogram("read_burst_frames")
         self._reader_task = asyncio.get_running_loop().create_task(self._read_loop())
 
     def _walk_frames(self, buf: bytes | bytearray) -> tuple[list, int]:
@@ -97,10 +108,14 @@ class TcpConnection(Connection):
                 chunk = await self._reader.read(1 << 16)
                 if not chunk:
                     break
+                self._m_bytes_in.inc(len(chunk))
                 buf += chunk
                 frames, consumed = self._walk_frames(buf)
                 if consumed:
                     del buf[:consumed]
+                if frames:
+                    self._m_frames_in.inc(len(frames))
+                    self._m_burst.record(len(frames))
                 for kind, corr, message, ok in frames:
                     if kind == _REQUEST:
                         if ok:
@@ -139,6 +154,8 @@ class TcpConnection(Connection):
     def _write_frame(self, kind: int, corr: int, payload: bytes) -> None:
         if self.closed:
             raise ConnectionClosedError("connection closed")
+        self._m_frames_out.inc()
+        self._m_bytes_out.inc(_HEADER.size + len(payload))
         self._writer.write(_HEADER.pack(len(payload), kind, corr) + payload)
 
     def _write_message(self, kind: int, corr: int, message: Any) -> None:
@@ -149,7 +166,13 @@ class TcpConnection(Connection):
         c = codec()
         if c is not None:
             try:
-                self._writer.write(c.encode_frames([(kind, corr, message)]))
+                data = c.encode_frames([(kind, corr, message)])
+                self._writer.write(data)
+                # count AFTER the write: a raising write falls through to
+                # the Python path, which counts the frame itself — counting
+                # first would tally one logical frame twice
+                self._m_frames_out.inc()
+                self._m_bytes_out.inc(len(data))
                 return
             except Exception:  # Fallback etc. — the Python path decides
                 pass
@@ -186,13 +209,18 @@ class TcpConnection(Connection):
 
 
 class TcpClient(Client):
-    def __init__(self, serializer_factory: Callable[[], Serializer]) -> None:
+    def __init__(self, serializer_factory: Callable[[], Serializer],
+                 metrics: MetricsRegistry | None = None) -> None:
         self._serializer_factory = serializer_factory
+        self._metrics = metrics
         self._connections: list[TcpConnection] = []
 
     async def connect(self, address: Address) -> Connection:
         reader, writer = await asyncio.open_connection(address.host, address.port)
-        conn = TcpConnection(reader, writer, self._serializer_factory())
+        if self._metrics is not None:
+            self._metrics.counter("connects").inc()
+        conn = TcpConnection(reader, writer, self._serializer_factory(),
+                             self._metrics)
         self._connections.append(conn)
         conn.on_close(lambda c: self._connections.remove(c) if c in self._connections else None)
         return conn
@@ -204,14 +232,19 @@ class TcpClient(Client):
 
 
 class TcpServer(Server):
-    def __init__(self, serializer_factory: Callable[[], Serializer]) -> None:
+    def __init__(self, serializer_factory: Callable[[], Serializer],
+                 metrics: MetricsRegistry | None = None) -> None:
         self._serializer_factory = serializer_factory
+        self._metrics = metrics
         self._server: asyncio.AbstractServer | None = None
         self._connections: list[TcpConnection] = []
 
     async def listen(self, address: Address, on_connect: Callable[[Connection], None]) -> None:
         def accept(reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
-            conn = TcpConnection(reader, writer, self._serializer_factory())
+            if self._metrics is not None:
+                self._metrics.counter("accepts").inc()
+            conn = TcpConnection(reader, writer, self._serializer_factory(),
+                                 self._metrics)
             self._connections.append(conn)
             conn.on_close(
                 lambda c: self._connections.remove(c) if c in self._connections else None
@@ -240,9 +273,12 @@ class TcpTransport(Transport):
 
     def __init__(self) -> None:
         self._factory = Serializer
+        #: shared by every connection this transport hands out
+        #: (bytes/frames in/out, read-burst histogram, connects/accepts)
+        self.metrics = MetricsRegistry()
 
     def client(self) -> Client:
-        return TcpClient(self._factory)
+        return TcpClient(self._factory, self.metrics)
 
     def server(self) -> Server:
-        return TcpServer(self._factory)
+        return TcpServer(self._factory, self.metrics)

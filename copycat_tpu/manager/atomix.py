@@ -160,6 +160,17 @@ class _Builder:
         self._kwargs["session_timeout"] = timeout
         return self
 
+    def with_stats_port(self, port: int,
+                        host: str = "127.0.0.1") -> "_Builder":
+        """Enable the HTTP stats listener (``server/stats.py``): JSON
+        snapshot at ``/stats``, Prometheus text at ``/metrics``, slow
+        traces at ``/traces``. Port 0 binds an ephemeral port (read it
+        back from ``.stats.port``). Binds loopback by default — the
+        surface is unauthenticated; widen ``host`` deliberately."""
+        self._kwargs["stats_port"] = port
+        self._kwargs["stats_host"] = host
+        return self
+
     def with_executor(self, executor: str,
                       engine_config: Any | None = None) -> "_Builder":
         """Select the resource executor: ``"cpu"`` (default) or ``"tpu"``
@@ -180,6 +191,8 @@ class _Builder:
             kwargs.pop("heartbeat_interval", None)
             kwargs.pop("executor", None)
             kwargs.pop("engine_config", None)
+            kwargs.pop("stats_port", None)
+            kwargs.pop("stats_host", None)
         return self._cls(**kwargs)
 
 
@@ -210,6 +223,8 @@ class AtomixReplica(Atomix):
         session_timeout: float = 5.0,
         executor: str = "cpu",
         engine_config: Any | None = None,
+        stats_port: int | None = None,
+        stats_host: str = "127.0.0.1",
     ) -> None:
         self.server = RaftServer(
             address, members, transport,
@@ -222,6 +237,9 @@ class AtomixReplica(Atomix):
             connection_strategy=PinnedConnectionStrategy(address))
         super().__init__(client)
         self.address = address
+        self._stats_port = stats_port
+        self._stats_host = stats_host
+        self.stats: Any = None
 
     @staticmethod
     def builder(address: Address, members: list[Address]) -> _Builder:
@@ -231,11 +249,29 @@ class AtomixReplica(Atomix):
         # Server first, then the client session (reference AtomixReplica.open).
         self.server.state_machine.prewarm()
         await self.server.open()
-        await self.client.open()
+        try:
+            if self._stats_port is not None:
+                from ..server.stats import StatsListener
+                self.stats = await StatsListener(
+                    self.server, host=self._stats_host,
+                    port=self._stats_port).open()
+            await self.client.open()
+        except BaseException:
+            # a failed stats bind / client open must not leak the opened
+            # server: Managed never marked US open, so the caller's
+            # close() would be a no-op
+            if self.stats is not None:
+                await self.stats.close()
+                self.stats = None
+            await self.server.close()
+            raise
 
     async def _do_close(self) -> None:
         self._resources.clear()
         await self.client.close()
+        if self.stats is not None:
+            await self.stats.close()
+            self.stats = None
         await self.server.close()
 
 
@@ -253,6 +289,8 @@ class AtomixServer(Managed):
         session_timeout: float = 5.0,
         executor: str = "cpu",
         engine_config: Any | None = None,
+        stats_port: int | None = None,
+        stats_host: str = "127.0.0.1",
     ) -> None:
         super().__init__()
         self.server = RaftServer(
@@ -262,6 +300,9 @@ class AtomixServer(Managed):
             election_timeout=election_timeout, heartbeat_interval=heartbeat_interval,
             session_timeout=session_timeout)
         self.address = address
+        self._stats_port = stats_port
+        self._stats_host = stats_host
+        self.stats: Any = None
 
     @staticmethod
     def builder(address: Address, members: list[Address]) -> _Builder:
@@ -270,8 +311,20 @@ class AtomixServer(Managed):
     async def _do_open(self) -> None:
         self.server.state_machine.prewarm()
         await self.server.open()
+        if self._stats_port is not None:
+            from ..server.stats import StatsListener
+            try:
+                self.stats = await StatsListener(
+                    self.server, host=self._stats_host,
+                    port=self._stats_port).open()
+            except BaseException:
+                await self.server.close()  # no leaked half-open node
+                raise
 
     async def _do_close(self) -> None:
+        if self.stats is not None:
+            await self.stats.close()
+            self.stats = None
         await self.server.close()
 
     async def leave(self) -> None:

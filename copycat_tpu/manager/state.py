@@ -20,6 +20,7 @@ from typing import Any, Callable
 
 from ..server.session import ServerSession, SessionState
 from ..server.state_machine import Commit, StateMachine, StateMachineExecutor
+from ..utils.metrics import MetricsRegistry
 from ..resource.operations import ResourceCommand
 from ..resource.state_machine import ResourceStateMachine, ResourceStateMachineExecutor
 from .operations import (
@@ -166,6 +167,10 @@ class ResourceManager(StateMachine):
         self.executor_kind = executor
         self._engine: Any = None
         self._engine_config = engine_config
+        # Catalog counters feed inline; point-in-time gauges refresh in
+        # stats() (the server's stats_snapshot pulls it — see
+        # docs/OBSERVABILITY.md).
+        self.metrics = MetricsRegistry()
 
     @property
     def device_engine(self) -> Any:
@@ -229,6 +234,7 @@ class ResourceManager(StateMachine):
             self.resources.pop(holder.resource_id, None)
             for iid in [i for i, h in self.instances.items() if h.resource is holder]:
                 del self.instances[iid]
+            self.metrics.counter("resources_deleted").inc()
             return True
         finally:
             commit.clean()
@@ -299,6 +305,7 @@ class ResourceManager(StateMachine):
         holder = ResourceHolder(resource_id, key, machine, executor,
                                 machine_cls=machine_cls)
         self.resources[resource_id] = holder
+        self.metrics.counter("resources_created").inc()
         return holder
 
     def _instantiate_machine(self, machine_cls: type) -> ResourceStateMachine:
@@ -322,6 +329,27 @@ class ResourceManager(StateMachine):
         self.instances[instance_id] = instance
         holder.state_machine.register(session)
         return instance
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """Catalog stats for the server's ``stats_snapshot()``: resource
+        and instance gauges, create/delete counters, device-engine group
+        occupancy when the TPU executor is live."""
+        m = self.metrics
+        m.gauge("resources").set(len(self.resources))
+        m.gauge("instances").set(len(self.instances))
+        device_backed = sum(
+            1 for h in self.resources.values()
+            if getattr(h.state_machine, "_group", None) is not None)
+        m.gauge("resources_device_backed").set(device_backed)
+        if self._engine is not None:
+            groups_used = getattr(self._engine, "_next_group", None)
+            if groups_used is not None:
+                m.gauge("device_groups_used").set(int(groups_used))
+        out = m.snapshot()
+        out["executor"] = self.executor_kind
+        return out
 
     # -- session lifecycle fan-out (SURVEY.md §3.4) ------------------------
 

@@ -49,6 +49,7 @@ committed ops/s on one chip through THIS sessioned surface.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Callable, NamedTuple
 
 import numpy as np
@@ -260,12 +261,21 @@ class BulkSessionClient:
         fan-out, cleanup commits), deliver events. Returns the number of
         session commands committed."""
         rg = self._rg
+        metrics = rg.metrics
+        t_flush = time.perf_counter()
         # 1. liveness: flushing proves this client's sessions are alive
         #    (they share this runtime), exactly like the reference's
         #    connection-level keep-alive covering all its sessions.
+        t_ka = time.perf_counter()
+        live = 0
         for s in self._sessions.values():
             if s.is_open:
+                live += 1
                 self._registry.keep_alive(s.id)
+        metrics.histogram("session_keepalive_ms").record(
+            (time.perf_counter() - t_ka) * 1e3)
+        metrics.gauge("sessions_live").set(live)
+        metrics.gauge("sessions_closing").set(len(self._closed))
         # 2. expiry sweep — fans out cleanup ops for dead sessions
         #    (pending_cleanup on monotone engines, submit queues on
         #    classic ones).
@@ -289,8 +299,10 @@ class BulkSessionClient:
         # session's listeners on the flush that commits the close, not
         # never.
         leaving: list[BulkSession] = []
+        expired = 0
         for s in list(self._sessions.values()):
             if s._dev.expired:
+                expired += 1
                 for ch in s._pending:
                     s._results.update(
                         (q, _EXPIRED)
@@ -344,6 +356,9 @@ class BulkSessionClient:
                     # double-apply non-idempotent ops).
                     for s, ch in chunks:
                         if s is not None:
+                            metrics.counter(
+                                "session_commands_indeterminate").inc(
+                                    int(ch.groups.size))
                             s._results.update(
                                 (q, _INDETERMINATE)
                                 for q in range(ch.seq0,
@@ -399,6 +414,15 @@ class BulkSessionClient:
         self._deliver_events()
         for s in leaving:
             self._sessions.pop(s.id, None)
+        if expired:
+            # a counter, not a gauge: expiry is an EVENT per flush — a
+            # gauge would read 0 again one flush later and lose history
+            metrics.counter("sessions_expired_total").inc(expired)
+        metrics.gauge("session_event_backlog").set(
+            sum(len(evs) for evs in rg.events.values()))
+        metrics.counter("session_ops_committed").inc(committed)
+        metrics.histogram("session_flush_ms").record(
+            (time.perf_counter() - t_flush) * 1e3)
         return committed
 
     def _deliver_events(self) -> None:

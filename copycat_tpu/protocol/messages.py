@@ -133,7 +133,9 @@ class UnregisterResponse(Response):
 @serialize_with(206)
 class CommandRequest(Message):
     # seq: client-assigned sequence for exactly-once application.
-    _fields = ("session_id", "seq", "operation")
+    # trace: per-request trace id (utils/tracing.py) — None when tracing
+    # is disabled; a non-None id asks the server to record spans for it.
+    _fields = ("session_id", "seq", "operation", "trace")
 
 
 @serialize_with(207)
@@ -160,9 +162,9 @@ class CommandBatchRequest(Message):
     sequenced commands from one session (the client's same-turn submits
     coalesce; the reference's per-command RPC framing pays per-message
     overhead the batch amortizes). ``entries`` = [(seq, operation), ...]
-    in seq order."""
+    in seq order. ``trace`` as on CommandRequest (one id per batch)."""
 
-    _fields = ("session_id", "entries")
+    _fields = ("session_id", "entries", "trace")
 
 
 @serialize_with(225)

@@ -27,8 +27,10 @@ from ..io.transport import Address, Connection, Transport, TransportError
 from ..protocol import messages as msg
 from ..protocol.operations import Command, CommandConsistency, QueryConsistency
 from ..utils.managed import Managed
+from ..utils.metrics import MetricsRegistry
 from ..utils.scheduled import Scheduled
 from ..utils.tasks import spawn
+from ..utils.tracing import TRACER
 from .log import (
     CommandEntry,
     ConfigurationEntry,
@@ -112,6 +114,7 @@ class RaftServer(Managed):
         heartbeat_interval: float = 0.1,
         session_timeout: float = 5.0,
         name: str = "raft",
+        metrics: MetricsRegistry | None = None,
     ) -> None:
         super().__init__()
         self.address = address
@@ -184,6 +187,28 @@ class RaftServer(Managed):
         # an escape hatch.
         self._vector_pump = os.environ.get(
             "COPYCAT_SERVER_VECTOR_PUMP", "1") != "0"
+
+        # Observability plane (docs/OBSERVABILITY.md): counters and
+        # histograms feed inline on the hot paths (a bare int add);
+        # point-in-time gauges (term/role/lag/sessions) are refreshed
+        # lazily by stats_snapshot() so the consensus path never pays
+        # for a metric nobody is reading. Per-entry/per-RPC metric
+        # objects are cached here so those paths never pay a registry
+        # lookup (same rule as the transports).
+        self.metrics = metrics or MetricsRegistry()
+        m = self.metrics
+        self._m_apply_entry = m.counter("applies_per_entry")
+        self._m_append_entries = m.histogram("append_batch_entries")
+        self._m_heartbeats = m.counter("append_heartbeats")
+        self._m_vector_refused = m.counter("vector_classify_refused")
+        self._m_single_lane = m.counter("commands_single_lane")
+        self._m_fast_lane = m.counter("commands_fast_lane")
+        self._m_general_lane = m.counter("commands_general_lane")
+        self._m_keepalive_ms = m.histogram("keepalive_latency_ms")
+        self._m_append_block = m.histogram("append_block_entries")
+        self._m_vector_runs = m.counter("vector_runs")
+        self._m_vector_ops = m.counter("vector_ops")
+        self._m_run_length = m.histogram("apply_run_length")
 
         self._load_meta()
 
@@ -338,6 +363,7 @@ class RaftServer(Managed):
         self.leader_address = None
         self._persist_meta()
         term = self.term
+        self.metrics.counter("raft_elections_started").inc()
         logger.debug("%s starting election for term %d", self.address, term)
         self._reset_election_timer()  # re-elect if this round stalls
 
@@ -383,6 +409,7 @@ class RaftServer(Managed):
             return
         self.role = LEADER
         self.leader_address = self.address
+        self.metrics.counter("raft_leader_transitions").inc()
         logger.info("%s elected leader for term %d", self.address, self.term)
         if self._election_timer is not None:
             self._election_timer.cancel()
@@ -639,6 +666,10 @@ class RaftServer(Managed):
         return msg.VoteResponse(term=self.term, voted=False)
 
     async def _on_append(self, request: msg.AppendRequest) -> msg.AppendResponse:
+        if request.entries:
+            self._m_append_entries.record(len(request.entries))
+        else:
+            self._m_heartbeats.inc()
         if request.term < self.term:
             return msg.AppendResponse(term=self.term, success=False,
                                       last_index=self.log.last_index)
@@ -775,6 +806,7 @@ class RaftServer(Managed):
             return msg.KeepAliveResponse(error=msg.UNKNOWN_SESSION, members=self.members)
         session.connection = connection
         session.last_contact = time.monotonic()
+        t0 = time.perf_counter()
         try:
             await self._append_and_wait(KeepAliveEntry(
                 session_id=request.session_id,
@@ -782,6 +814,7 @@ class RaftServer(Managed):
                 event_index=request.event_index or 0))
         except msg.ProtocolError as e:
             return msg.KeepAliveResponse(error=e.code, leader=e.leader, members=self.members)
+        self._m_keepalive_ms.record((time.perf_counter() - t0) * 1e3)
         # Resend any event batches the client is missing.
         self._flush_events(session)
         return msg.KeepAliveResponse(members=self.members)
@@ -807,15 +840,24 @@ class RaftServer(Managed):
         session.connection = connection
         session.last_contact = time.monotonic()
         seq = request.seq
+        self._m_single_lane.inc()
+        trace = request.trace
+        t0 = time.perf_counter() if trace is not None else 0.0
 
         staged, payload = self._stage_command(session, seq, request.operation)
         if staged == "done":
             index, result, error = payload
+            if trace is not None:
+                TRACER.span(trace, "server.cached", t0, time.perf_counter(),
+                            seq=seq)
             return self._command_response(session, index, result, error)
         if staged == "err":
             code, detail = payload
             return msg.CommandResponse(error=code, error_detail=detail)
         fut = payload
+        if trace is not None:
+            t1 = time.perf_counter()
+            TRACER.span(trace, "server.append", t0, t1, seq=seq)
         try:
             index, result, error = await fut
         except msg.ProtocolError as e:
@@ -823,6 +865,9 @@ class RaftServer(Managed):
         finally:
             if session.command_futures.get(seq) is fut:
                 del session.command_futures[seq]
+        if trace is not None:
+            TRACER.span(trace, "server.commit", t1, time.perf_counter(),
+                        index=index)
         return self._command_response(session, index, result, error)
 
     def _stage_command(self, session: ServerSession, seq: int,
@@ -879,6 +924,8 @@ class RaftServer(Managed):
         session.connection = connection
         session.last_contact = time.monotonic()
         entries = request.entries or []
+        trace = request.trace
+        t0 = time.perf_counter() if trace is not None else 0.0
         # FAST LANE: a fresh contiguous seq run with nothing pending
         # stages as one append block behind ONE commit future — no
         # per-seq futures, no per-entry dedup dict walks; responses read
@@ -894,9 +941,14 @@ class RaftServer(Managed):
                 # the per-entry Python walk on 1k-op batches
                 and [e[0] for e in entries]
                 == list(range(entries[0][0], entries[0][0] + n))):
-            return await self._command_batch_fast(session, entries)
+            self._m_fast_lane.inc(n)
+            return await self._command_batch_fast(session, entries, trace, t0)
+        self._m_general_lane.inc(n)
         staged = [(seq, *self._stage_command(session, seq, op))
                   for seq, op in entries]
+        if trace is not None:
+            t1 = time.perf_counter()
+            TRACER.span(trace, "server.append", t0, t1, n=n)
         entries = []
         for seq, kind, payload in staged:
             if kind == "done":
@@ -927,11 +979,15 @@ class RaftServer(Managed):
                 finally:
                     if session.command_futures.get(seq) is fut:
                         del session.command_futures[seq]
+        if trace is not None:
+            TRACER.span(trace, "server.commit", t1, time.perf_counter(), n=n)
         return msg.CommandBatchResponse(event_index=session.event_index,
                                         entries=entries)
 
     async def _command_batch_fast(self, session: ServerSession,
-                                  entries: list) -> msg.CommandBatchResponse:
+                                  entries: list, trace: int | None = None,
+                                  t0: float = 0.0
+                                  ) -> msg.CommandBatchResponse:
         """Stage a fresh contiguous command run as one append block.
 
         Inlines ``_append``'s per-entry tail (term/timestamp stamp + log
@@ -945,6 +1001,7 @@ class RaftServer(Managed):
         now = time.time()
         index = self.log.append_block(
             [CommandEntry(term, now, sid, seq, op) for seq, op in entries])
+        self._m_append_block.record(len(entries))
         session.next_append_seq = entries[0][0] + len(entries)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._commit_futures[index] = fut
@@ -952,6 +1009,10 @@ class RaftServer(Managed):
         if len(self.members) == 1 and not self._advance_scheduled:
             self._advance_scheduled = True
             asyncio.get_running_loop().call_soon(self._advance_deferred)
+        if trace is not None:
+            t1 = time.perf_counter()
+            TRACER.span(trace, "server.append", t0, t1, index=index,
+                        n=len(entries))
         try:
             await fut
         except msg.ProtocolError as e:
@@ -965,6 +1026,9 @@ class RaftServer(Managed):
                 event_index=session.event_index,
                 entries=[(seq, 0, None, e.code, e.detail)
                          for seq, _ in entries])
+        if trace is not None:
+            t2 = time.perf_counter()
+            TRACER.span(trace, "server.commit", t1, t2, index=index)
         if self._event_pushes:
             # Events-before-response (reference Consistency.java:157-176):
             # the general path gates each LINEARIZABLE response on its
@@ -992,6 +1056,8 @@ class RaftServer(Managed):
                 idx, result, error = cached
                 out.append((seq, idx, result,
                             msg.APPLICATION if error else None, error))
+        if trace is not None:
+            TRACER.span(trace, "server.respond", t2, time.perf_counter())
         return msg.CommandBatchResponse(event_index=session.event_index,
                                         entries=out)
 
@@ -1118,6 +1184,7 @@ class RaftServer(Managed):
                     if rec is not None:
                         vrun.append(rec)
                         continue
+                    self._m_vector_refused.inc()
                 if vrun:
                     # an ineligible entry bounds the run: commit the
                     # staged tensors first so log order is preserved.
@@ -1207,6 +1274,9 @@ class RaftServer(Managed):
             window.barrier()  # drain in-flight chains: log order
         engine = self.state_machine.device_engine
         n = len(run)
+        self._m_vector_runs.inc()
+        self._m_vector_ops.inc(n)
+        self._m_run_length.record(n)
         groups = [0] * n
         opc = [0] * n
         av = [0] * n
@@ -1263,6 +1333,7 @@ class RaftServer(Managed):
         self.executor.tick(clock)  # no deadline <= clock (classify gate)
 
     def _apply_entry(self, entry: Entry, window: Any = None) -> None:
+        self._m_apply_entry.inc()
         if (window is not None and window.busy
                 and not isinstance(entry, CommandEntry)):
             # Session/config/noop entries read state that in-flight device
@@ -1401,6 +1472,9 @@ class RaftServer(Managed):
         if session is None:
             self.log.clean(entry.index)
             return
+        self.metrics.counter(
+            "sessions_expired_total" if entry.expired
+            else "sessions_closed_total").inc()
         if entry.expired:
             session.expire()
             self.state_machine.expire(session)
@@ -1503,6 +1577,51 @@ class RaftServer(Managed):
             spawn(complete_after_events(), name="events-before-response")
         else:
             fut.set_result(payload)
+
+    # ------------------------------------------------------------------
+    # observability (docs/OBSERVABILITY.md)
+    # ------------------------------------------------------------------
+
+    def stats_snapshot(self) -> dict:
+        """Point-in-time stats for the stats listener / ``copycat-tpu
+        stats``: refreshes the lazy gauges (term/role/lag/sessions) then
+        returns ``{node, role, term, leader, raft, transport?, manager?}``
+        — raft is this server's registry snapshot, transport the
+        transport's (if it keeps one), manager the state machine's
+        ``stats()`` (ResourceManager: resource/instance gauges)."""
+        m = self.metrics
+        m.gauge("raft_term").set(self.term)
+        m.gauge("raft_is_leader").set(1 if self.role == LEADER else 0)
+        m.gauge("raft_commit_index").set(self.commit_index)
+        m.gauge("raft_last_applied").set(self.last_applied)
+        m.gauge("raft_log_last_index").set(self.log.last_index)
+        # commit lag: appended-but-uncommitted entries; apply lag:
+        # committed-but-unapplied — both 0 in a healthy quiet cluster.
+        m.gauge("raft_commit_lag").set(self.log.last_index - self.commit_index)
+        m.gauge("raft_apply_lag").set(self.commit_index - self.last_applied)
+        m.gauge("raft_members").set(len(self.members))
+        live = 0
+        queue_depth = 0
+        for session in self.sessions.values():
+            if session.state is SessionState.OPEN:
+                live += 1
+            queue_depth += len(session.event_queue)
+        m.gauge("sessions_open").set(live)
+        m.gauge("session_event_queue_depth").set(queue_depth)
+        snap: dict = {
+            "node": str(self.address),
+            "role": self.role,
+            "term": self.term,
+            "leader": str(self.leader_address) if self.leader_address else None,
+            "raft": m.snapshot(),
+        }
+        transport_metrics = getattr(self.transport, "metrics", None)
+        if transport_metrics is not None:
+            snap["transport"] = transport_metrics.snapshot()
+        sm_stats = getattr(self.state_machine, "stats", None)
+        if callable(sm_stats):
+            snap["manager"] = sm_stats()
+        return snap
 
     # ------------------------------------------------------------------
     # event push (leader only)

@@ -1,0 +1,165 @@
+"""Opt-in live stats surface: an HTTP listener over a running server.
+
+The observability plane's exposition layer (docs/OBSERVABILITY.md): a
+tiny dependency-free HTTP/1.1 responder on asyncio streams (the stats
+port must work even when the cluster transport is LocalTransport or the
+native loop — it is always a real TCP socket, so ``curl`` and Prometheus
+can scrape a test cluster).
+
+Routes:
+
+- ``/stats`` (also ``/`` and ``/stats.json``) — the full JSON snapshot
+  (``RaftServer.stats_snapshot()``: node/role/term/leader + raft,
+  transport and manager registries).
+- ``/metrics`` — Prometheus text exposition: the raft registry under
+  ``copycat_*``, the transport's under ``copycat_transport_*``, the
+  resource manager's under ``copycat_manager_*``.
+- ``/traces`` — JSON dump of the slowest traced requests
+  (``utils/tracing.py``); ``/traces.txt`` for the human rendering.
+
+Enable with ``AtomixServer(..., stats_port=N)`` /
+``copycat-server --stats-port N``; read with ``copycat-tpu stats
+<host:port>`` or anything that speaks HTTP.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any
+
+from ..utils.metrics import MetricsRegistry
+from ..utils.tracing import TRACER
+
+logger = logging.getLogger(__name__)
+
+
+class StatsListener:
+    """Serves one RaftServer's observability surface over HTTP.
+
+    Binds loopback by default: the surface is unauthenticated (and
+    ``/traces`` carries operation metadata), so exposure beyond the
+    host is an explicit choice (``--stats-host`` /
+    ``with_stats_port(port, host=...)``)."""
+
+    def __init__(self, raft_server: Any, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self._raft = raft_server
+        self._host = host
+        self._port = port
+        self._server: asyncio.AbstractServer | None = None
+
+    @property
+    def port(self) -> int:
+        """The bound port (resolves ``port=0`` to the ephemeral pick)."""
+        if self._server is not None and self._server.sockets:
+            return self._server.sockets[0].getsockname()[1]
+        return self._port
+
+    async def open(self) -> "StatsListener":
+        self._server = await asyncio.start_server(
+            self._serve, self._host, self._port)
+        logger.info("stats listener on %s:%d", self._host, self.port)
+        return self
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 2.0)
+            except (TimeoutError, asyncio.TimeoutError):
+                pass
+            self._server = None
+
+    # -- request handling --------------------------------------------------
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        try:
+            request_line = await asyncio.wait_for(reader.readline(), 5.0)
+            parts = request_line.decode("latin-1").split()
+            path = parts[1] if len(parts) >= 2 else "/"
+            # drain headers (ignored; every route is a parameterless GET)
+            while True:
+                line = await asyncio.wait_for(reader.readline(), 5.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            body, ctype = self._route(path.split("?", 1)[0])
+            writer.write(
+                b"HTTP/1.1 200 OK\r\n"
+                + f"Content-Type: {ctype}\r\n".encode()
+                + f"Content-Length: {len(body)}\r\n".encode()
+                + b"Connection: close\r\n\r\n" + body)
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionResetError, OSError,
+                asyncio.IncompleteReadError):
+            pass
+        except Exception:
+            logger.exception("stats request failed")
+            try:
+                writer.write(b"HTTP/1.1 500 Internal Server Error\r\n"
+                             b"Content-Length: 0\r\nConnection: close\r\n\r\n")
+                await writer.drain()
+            except Exception:
+                pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def _route(self, path: str) -> tuple[bytes, str]:
+        if path == "/metrics":
+            return self._prometheus().encode(), "text/plain; version=0.0.4"
+        if path == "/traces":
+            return TRACER.dump_slowest(20, as_json=True).encode(), \
+                "application/json"
+        if path == "/traces.txt":
+            return TRACER.dump_slowest(20).encode(), "text/plain"
+        if path in ("/", "/stats", "/stats.json"):
+            return json.dumps(self._raft.stats_snapshot()).encode(), \
+                "application/json"
+        return (json.dumps({"error": f"unknown path {path}",
+                            "routes": ["/stats", "/metrics", "/traces",
+                                       "/traces.txt"]}).encode(),
+                "application/json")
+
+    def _prometheus(self) -> str:
+        self._raft.stats_snapshot()  # refresh the lazy gauges
+        out = [self._raft.metrics.render_prometheus()]
+        transport_metrics = getattr(self._raft.transport, "metrics", None)
+        if isinstance(transport_metrics, MetricsRegistry):
+            out.append(transport_metrics.render_prometheus(
+                namespace="copycat_transport"))
+        manager_metrics = getattr(self._raft.state_machine, "metrics", None)
+        if isinstance(manager_metrics, MetricsRegistry):
+            out.append(manager_metrics.render_prometheus(
+                namespace="copycat_manager"))
+        return "".join(out)
+
+
+async def fetch_stats(address: str, path: str = "/stats",
+                      timeout: float = 5.0) -> bytes:
+    """Minimal HTTP GET against a stats listener (no external deps —
+    what ``copycat-tpu stats`` uses). ``address`` is ``host:port``."""
+    host, _, port = address.rpartition(":")
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host or "127.0.0.1", int(port)), timeout)
+    try:
+        writer.write(f"GET {path} HTTP/1.1\r\nHost: {address}\r\n"
+                     f"Connection: close\r\n\r\n".encode())
+        await writer.drain()
+        raw = await asyncio.wait_for(reader.read(), timeout)
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except (ConnectionResetError, OSError):
+            pass
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status = head.split(b"\r\n", 1)[0].split()
+    if len(status) < 2 or status[1] != b"200":
+        first = head.splitlines()[0] if head else b"(empty response)"
+        raise RuntimeError(f"stats fetch failed: {first!r}")
+    return body

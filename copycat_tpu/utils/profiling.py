@@ -34,31 +34,52 @@ def xla_trace(trace_dir: str | None) -> Iterator[None]:
         yield
 
 
-def summarize_trace(trace_dir: str, top: int = 15) -> list[tuple[str, float, int]]:
-    """Aggregate device-op time from the NEWEST captured trace session.
+def find_xplane_files(trace_dir: str) -> list[str]:
+    """The ``*.xplane.pb`` files of the NEWEST capture session under
+    ``trace_dir``.
 
-    Returns ``[(op_name, total_ms, count), ...]`` sorted by time — enough
-    to find the hot op without a TensorBoard UI. Only events on device
-    (TPU/accelerator) lanes are counted, so host-side spans and module
-    wrappers don't drown the per-op numbers. Requires the ``xprof``
-    package (present in the image).
+    The standard jax layout is one timestamped subdir per capture under
+    ``plugins/profile/``; some jax/tensorboard-plugin versions nest
+    differently, so when that glob comes up empty the whole tree is
+    scanned and the files are grouped by parent directory (newest
+    mtime wins) — only the newest session is summarized either way, so
+    reused trace dirs don't merge runs.
     """
-    import collections
     import glob
-    import json
     import os
 
-    from xprof.convert import raw_to_tool_data as rtd
-
-    # jax.profiler.trace writes one timestamped session subdir per capture;
-    # summarize only the newest so reused trace dirs don't merge runs.
     sessions = sorted(glob.glob(f"{trace_dir}/plugins/profile/*/"))
-    if not sessions:
-        raise FileNotFoundError(f"no profile sessions under {trace_dir}")
-    files = glob.glob(os.path.join(sessions[-1], "*.xplane.pb"))
-    data, _ = rtd.xspace_to_tool_data(files, "trace_viewer", {})
-    trace = json.loads(data.decode() if isinstance(data, bytes) else data)
-    events = trace["traceEvents"]
+    if sessions:
+        files = glob.glob(os.path.join(sessions[-1], "*.xplane.pb"))
+        if files:
+            return files
+    # Layout fallback: find xplane files anywhere below, newest
+    # session-dir (by mtime) only.
+    by_dir: dict[str, list[str]] = {}
+    for root, _dirs, names in os.walk(trace_dir):
+        for name in names:
+            if name.endswith(".xplane.pb"):
+                by_dir.setdefault(root, []).append(os.path.join(root, name))
+    if not by_dir:
+        raise FileNotFoundError(
+            f"no profile sessions under {trace_dir}: expected "
+            f"plugins/profile/<session>/*.xplane.pb (or any *.xplane.pb "
+            f"below it) — did the traced region actually run?")
+    newest = max(by_dir, key=lambda d: os.path.getmtime(d))
+    return sorted(by_dir[newest])
+
+
+def aggregate_trace_events(events: list[dict],
+                           top: int = 15) -> list[tuple[str, float, int]]:
+    """Aggregate device-lane op time from trace-viewer JSON events.
+
+    Returns ``[(op_name, total_ms, count), ...]`` sorted by time. Only
+    events on device (TPU/accelerator) lanes are counted, so host-side
+    spans and module wrappers don't drown the per-op numbers. Split out
+    of :func:`summarize_trace` so the aggregation is testable against a
+    canned trace JSON without ``xprof`` or a TPU.
+    """
+    import collections
 
     # Map pid -> process name from metadata events; keep device lanes only.
     proc: dict = {}
@@ -78,3 +99,27 @@ def summarize_trace(trace_dir: str, top: int = 15) -> list[tuple[str, float, int
         agg[name] += event.get("dur", 0)
         cnt[name] += 1
     return [(name, dur / 1e3, cnt[name]) for name, dur in agg.most_common(top)]
+
+
+def summarize_trace(trace_dir: str, top: int = 15) -> list[tuple[str, float, int]]:
+    """Aggregate device-op time from the NEWEST captured trace session.
+
+    Returns ``[(op_name, total_ms, count), ...]`` sorted by time — enough
+    to find the hot op without a TensorBoard UI. Requires the ``xprof``
+    package to parse the raw ``.xplane.pb`` capture; without it the
+    error says so instead of surfacing an opaque import chain.
+    """
+    import json
+
+    try:
+        from xprof.convert import raw_to_tool_data as rtd
+    except ImportError as exc:
+        raise RuntimeError(
+            "summarize_trace needs the 'xprof' package to parse raw "
+            ".xplane.pb captures (pip install xprof, or open the trace "
+            f"dir in TensorBoard instead): {exc}") from exc
+
+    files = find_xplane_files(trace_dir)
+    data, _ = rtd.xspace_to_tool_data(files, "trace_viewer", {})
+    trace = json.loads(data.decode() if isinstance(data, bytes) else data)
+    return aggregate_trace_events(trace["traceEvents"], top)

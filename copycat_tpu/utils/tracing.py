@@ -1,0 +1,183 @@
+"""Per-request tracing: a trace id carried from client submit through
+log append, commit/apply, and response (SURVEY.md §5.1 names tracing a
+build obligation; the XLA profiler in :mod:`profiling` covers the device
+plane — this covers the host request path).
+
+Design constraints, in order:
+
+1. **Zero overhead when disabled.** The hot path (client submit, server
+   command handlers) does ONE attribute read (``TRACER.enabled``) and
+   branches away. No span objects, no clock reads, no dict lookups.
+   Verified by the spi bench A/B in PERF.md.
+2. **Propagation rides the existing frames.** ``CommandRequest`` /
+   ``CommandBatchRequest`` grew a trailing ``trace`` field
+   (``protocol/messages.py``); it is ``None`` when tracing is off, and a
+   server records spans whenever a request carries a non-None id — the
+   client's flag IS the propagation switch, so a traced client against
+   an untouched server config still yields server-side spans.
+3. **Bounded storage.** Completed spans land in a per-process ring
+   (``capacity`` traces, oldest evicted); :meth:`Tracer.dump_slowest`
+   renders the slowest N requests as text or JSON.
+
+Usage::
+
+    from copycat_tpu.utils import tracing
+
+    tracing.enable()                  # or COPYCAT_TRACE=1 in the env
+    ... drive requests ...
+    print(tracing.TRACER.dump_slowest(5))
+
+Span semantics (one trace per wire request; names are stable API,
+documented in docs/OBSERVABILITY.md):
+
+- ``client.submit`` — client-side, submit flush -> responses correlated
+  (includes connect/retry time).
+- ``server.append`` — server receipt -> log append staged (meta:
+  ``index``, ``n`` entries).
+- ``server.commit`` — append -> commit future resolved (replication +
+  quorum + APPLY: the entry's state-machine application completes
+  before its future resolves).
+- ``server.respond`` — commit -> response object built (event gating).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+from collections import OrderedDict
+from typing import Any
+
+_ids = itertools.count(1)
+
+
+class Span:
+    __slots__ = ("trace_id", "name", "start", "end", "meta")
+
+    def __init__(self, trace_id: int, name: str, start: float, end: float,
+                 meta: dict | None = None) -> None:
+        self.trace_id = trace_id
+        self.name = name
+        self.start = start
+        self.end = end
+        self.meta = meta
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end - self.start) * 1e3
+
+    def as_dict(self) -> dict:
+        d = {"trace": self.trace_id, "name": self.name,
+             "start": round(self.start, 6),
+             "duration_ms": round(self.duration_ms, 3)}
+        if self.meta:
+            d.update(self.meta)
+        return d
+
+    def __repr__(self) -> str:
+        return (f"Span({self.name} trace={self.trace_id} "
+                f"{self.duration_ms:.3f}ms)")
+
+
+class Tracer:
+    """Ring-buffered span storage keyed by trace id.
+
+    ``enabled`` is a plain attribute so the disabled check costs one
+    LOAD_ATTR; every recording entry point re-checks nothing else.
+    """
+
+    #: hard cap on spans recorded per trace id: a request produces ~5,
+    #: so the cap only bites a peer replaying one id forever — without
+    #: it that would grow a server-side list without bound (spans are
+    #: recorded for ANY non-None wire id, even with local tracing off)
+    MAX_SPANS_PER_TRACE = 64
+
+    def __init__(self, capacity: int = 512) -> None:
+        self.enabled = False
+        self.capacity = capacity
+        self._traces: "OrderedDict[int, list[Span]]" = OrderedDict()
+
+    # -- recording ---------------------------------------------------------
+
+    def new_trace(self) -> int:
+        """A fresh trace id (call only when ``enabled`` — callers branch
+        on the attribute first; ids are process-unique, not global)."""
+        return next(_ids)
+
+    def span(self, trace_id: int, name: str, start: float, end: float,
+             **meta: Any) -> None:
+        """Record one completed span under ``trace_id``.
+
+        Explicit timestamps fit the async call sites (the caller already
+        holds t0 from before its awaits). Accepts any trace id —
+        including one minted by a REMOTE client and carried in a frame.
+        """
+        spans = self._traces.get(trace_id)
+        if spans is None:
+            if len(self._traces) >= self.capacity:
+                self._traces.popitem(last=False)
+            spans = self._traces[trace_id] = []
+        if len(spans) < self.MAX_SPANS_PER_TRACE:
+            spans.append(Span(trace_id, name, start, end, meta or None))
+
+    # -- reading -----------------------------------------------------------
+
+    def traces(self) -> dict[int, list[Span]]:
+        return dict(self._traces)
+
+    def spans_for(self, trace_id: int) -> list[Span]:
+        return list(self._traces.get(trace_id, ()))
+
+    def slowest(self, n: int = 10) -> list[tuple[int, float, list[Span]]]:
+        """The slowest ``n`` traces as ``(trace_id, total_ms, spans)``,
+        total = wall span from first start to last end."""
+        scored = []
+        for trace_id, spans in self._traces.items():
+            total = (max(s.end for s in spans)
+                     - min(s.start for s in spans)) * 1e3
+            scored.append((trace_id, total, spans))
+        scored.sort(key=lambda t: t[1], reverse=True)
+        return scored[:n]
+
+    def dump_slowest(self, n: int = 10, as_json: bool = False) -> str:
+        slow = self.slowest(n)
+        if as_json:
+            return json.dumps([
+                {"trace": trace_id, "total_ms": round(total, 3),
+                 "spans": [s.as_dict() for s in spans]}
+                for trace_id, total, spans in slow])
+        lines = []
+        for trace_id, total, spans in slow:
+            lines.append(f"trace {trace_id}: {total:.3f} ms total")
+            t0 = min(s.start for s in spans)
+            for s in sorted(spans, key=lambda s: s.start):
+                meta = (" " + " ".join(f"{k}={v}" for k, v in s.meta.items())
+                        if s.meta else "")
+                lines.append(f"  +{(s.start - t0) * 1e3:8.3f} ms "
+                             f"{s.name:<16} {s.duration_ms:8.3f} ms{meta}")
+        return "\n".join(lines) if lines else "(no traces recorded)"
+
+    def clear(self) -> None:
+        self._traces.clear()
+
+
+#: the per-process tracer every layer records into (client + server in
+#: one process share it, so in-process tests see end-to-end traces; over
+#: TCP each process keeps its own ring, correlated by trace id).
+TRACER = Tracer()
+
+if os.environ.get("COPYCAT_TRACE", "") not in ("", "0"):
+    TRACER.enabled = True
+
+
+def enable() -> None:
+    TRACER.enabled = True
+
+
+def disable() -> None:
+    TRACER.enabled = False
+
+
+def now() -> float:
+    return time.perf_counter()

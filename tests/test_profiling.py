@@ -1,0 +1,75 @@
+"""summarize_trace plumbing (utils/profiling.py): canned trace-JSON
+aggregation, session-dir discovery fallback, and the missing-xprof
+error — none of which need a TPU or the xprof package."""
+
+import pytest
+
+from copycat_tpu.utils.profiling import (
+    aggregate_trace_events,
+    find_xplane_files,
+    summarize_trace,
+)
+
+#: a canned trace-viewer JSON event list: pid 1 is a device lane, pid 2
+#: a host lane whose events must NOT be counted, pid 3 has no metadata.
+CANNED_EVENTS = [
+    {"ph": "M", "name": "process_name", "pid": 1,
+     "args": {"name": "/device:TPU:0"}},
+    {"ph": "M", "name": "process_name", "pid": 2,
+     "args": {"name": "python host thread"}},
+    {"ph": "X", "pid": 1, "name": "fusion.42", "dur": 3000},
+    {"ph": "X", "pid": 1, "name": "fusion.42", "dur": 1000},
+    {"ph": "X", "pid": 1, "name": "copy.7", "dur": 500},
+    {"ph": "X", "pid": 2, "name": "host_overhead", "dur": 999999},
+    {"ph": "X", "pid": 3, "name": "unknown_lane", "dur": 12345},
+    {"ph": "B", "pid": 1, "name": "not_complete_event", "dur": 777},
+]
+
+
+def test_aggregate_counts_device_lanes_only():
+    rows = aggregate_trace_events(CANNED_EVENTS)
+    assert rows == [("fusion.42", 4.0, 2), ("copy.7", 0.5, 1)]
+
+
+def test_aggregate_top_truncates():
+    rows = aggregate_trace_events(CANNED_EVENTS, top=1)
+    assert rows == [("fusion.42", 4.0, 2)]
+
+
+def test_find_xplane_standard_layout_picks_newest_session(tmp_path):
+    old = tmp_path / "plugins" / "profile" / "2026_01_01_00_00_00"
+    new = tmp_path / "plugins" / "profile" / "2026_02_02_00_00_00"
+    for d in (old, new):
+        d.mkdir(parents=True)
+        (d / "host.xplane.pb").write_bytes(b"x")
+    files = find_xplane_files(str(tmp_path))
+    assert files == [str(new / "host.xplane.pb")]
+
+
+def test_find_xplane_falls_back_to_scanning(tmp_path):
+    # a layout some jax versions produce: no plugins/profile nesting
+    weird = tmp_path / "session_dir" / "nested"
+    weird.mkdir(parents=True)
+    (weird / "a.xplane.pb").write_bytes(b"x")
+    (weird / "b.xplane.pb").write_bytes(b"x")
+    files = find_xplane_files(str(tmp_path))
+    assert sorted(files) == [str(weird / "a.xplane.pb"),
+                             str(weird / "b.xplane.pb")]
+
+
+def test_find_xplane_empty_dir_is_actionable(tmp_path):
+    with pytest.raises(FileNotFoundError, match="xplane.pb"):
+        find_xplane_files(str(tmp_path))
+
+
+def test_summarize_trace_without_xprof_is_actionable(tmp_path, monkeypatch):
+    # sys.modules[name] = None makes `from xprof.convert import ...`
+    # raise ImportError — the no-xprof environment, simulated
+    import sys
+    monkeypatch.setitem(sys.modules, "xprof", None)
+    monkeypatch.setitem(sys.modules, "xprof.convert", None)
+    d = tmp_path / "plugins" / "profile" / "s1"
+    d.mkdir(parents=True)
+    (d / "host.xplane.pb").write_bytes(b"x")
+    with pytest.raises(RuntimeError, match="xprof"):
+        summarize_trace(str(tmp_path))

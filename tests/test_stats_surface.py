@@ -1,0 +1,176 @@
+"""The live stats surface: HTTP listener, Prometheus/JSON renderers,
+and the ``copycat-tpu stats`` CLI verb against a running server."""
+
+import asyncio
+import json
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from copycat_tpu import cli  # noqa: E402
+from copycat_tpu.atomic import DistributedAtomicLong  # noqa: E402
+from copycat_tpu.io.local import LocalServerRegistry, LocalTransport  # noqa: E402
+from copycat_tpu.io.transport import Address  # noqa: E402
+from copycat_tpu.manager.atomix import AtomixClient, AtomixServer  # noqa: E402
+from copycat_tpu.server.stats import fetch_stats  # noqa: E402
+from copycat_tpu.utils import tracing  # noqa: E402
+
+from helpers import async_test  # noqa: E402
+
+
+async def _running_server():
+    """One AtomixServer (local raft transport, REAL TCP stats port) plus
+    a client that drove some public-API traffic through it."""
+    registry = LocalServerRegistry()
+    transport = LocalTransport(registry)
+    addr = Address("127.0.0.1", 16123)
+    server = AtomixServer(addr, [addr], transport, session_timeout=30.0,
+                          stats_port=0)
+    await server.open()
+    client = AtomixClient([addr], transport, session_timeout=30.0)
+    await client.open()
+    counter = await client.get("hits", DistributedAtomicLong)
+    # solo submit -> single lane; same-turn burst -> batch (fast lane)
+    await counter.increment_and_get()
+    await asyncio.gather(*(counter.increment_and_get() for _ in range(8)))
+    return server, client
+
+
+@async_test(timeout=120)
+async def test_stats_listener_serves_snapshot_and_metrics():
+    server, client = await _running_server()
+    try:
+        port = server.stats.port
+        assert port > 0
+        body = await fetch_stats(f"127.0.0.1:{port}", "/stats")
+        snap = json.loads(body)
+        # per-node raft gauges
+        assert snap["node"] == "127.0.0.1:16123"
+        assert snap["role"] == "leader"
+        raft = snap["raft"]
+        assert raft["raft_term"] >= 1
+        assert raft["raft_is_leader"] == 1
+        assert raft["raft_commit_lag"] == 0
+        assert raft["raft_commit_index"] > 0
+        assert raft["sessions_open"] >= 1
+        # SPI lane counters: the burst rode the batch lanes
+        assert raft.get("commands_single_lane", 0) >= 1
+        lanes = (raft.get("commands_fast_lane", 0)
+                 + raft.get("commands_general_lane", 0))
+        assert lanes >= 8
+        # transport frame accounting
+        transport = snap["transport"]
+        assert transport["frames_in"] > 0
+        assert transport["bytes_out"] > 0
+        # resource manager stats
+        manager = snap["manager"]
+        assert manager["resources"] == 1
+        assert manager["instances"] == 1
+        assert manager["executor"] == "cpu"
+        # client-side latency percentiles exist for the same traffic
+        lat = client.client.metrics.snapshot()["submit_latency_ms"]
+        assert lat["count"] >= 2 and lat["p99"] > 0
+
+        prom = (await fetch_stats(f"127.0.0.1:{port}", "/metrics")).decode()
+        assert "# TYPE copycat_raft_term gauge" in prom
+        assert "copycat_raft_is_leader 1" in prom
+        assert "copycat_transport_frames_in" in prom
+        assert "copycat_manager_resources" in prom
+
+        unknown = json.loads(
+            await fetch_stats(f"127.0.0.1:{port}", "/nope"))
+        assert "/metrics" in unknown["routes"]
+    finally:
+        await client.close()
+        await server.close()
+
+
+@async_test(timeout=120)
+async def test_traces_route_shows_spans():
+    tracing.disable()
+    tracing.TRACER.clear()
+    server, client = await _running_server()
+    try:
+        tracing.enable()
+        counter = await client.get("hits", DistributedAtomicLong)
+        await asyncio.gather(*(counter.increment_and_get()
+                               for _ in range(4)))
+        tracing.disable()
+        port = server.stats.port
+        traces = json.loads(await fetch_stats(f"127.0.0.1:{port}",
+                                              "/traces"))
+        assert traces, "no traces served"
+        names = {s["name"] for t in traces for s in t["spans"]}
+        assert "client.submit" in names
+        assert "server.commit" in names
+        text = (await fetch_stats(f"127.0.0.1:{port}",
+                                  "/traces.txt")).decode()
+        assert "server.append" in text
+    finally:
+        tracing.disable()
+        tracing.TRACER.clear()
+        await client.close()
+        await server.close()
+
+
+def test_cli_stats_verb(capsys):
+    async def run():
+        server, client = await _running_server()
+        port = server.stats.port
+        try:
+            # the CLI verb's fetch+render path (the console script wraps
+            # exactly this); to_thread because _stats owns its own
+            # asyncio.run, like the real process would
+            rc = await asyncio.to_thread(
+                cli._stats, type("A", (), {"address": f"127.0.0.1:{port}",
+                                           "what": "stats"})())
+            assert rc == 0
+            rc = await asyncio.to_thread(
+                cli._stats, type("A", (), {"address": f"127.0.0.1:{port}",
+                                           "what": "metrics"})())
+            assert rc == 0
+        finally:
+            await client.close()
+            await server.close()
+
+    asyncio.run(asyncio.wait_for(run(), 110))
+    out = capsys.readouterr().out
+    assert '"raft_is_leader": 1' in out or '"raft_is_leader": 1.0' in out
+    assert "copycat_raft_term" in out
+
+
+@async_test(timeout=60)
+async def test_failed_stats_bind_does_not_leak_the_server():
+    """A stats port that cannot bind must close the already-opened raft
+    server on the way out (Managed never marked the node open, so the
+    caller's close() would be a no-op)."""
+    registry = LocalServerRegistry()
+    transport = LocalTransport(registry)
+    addr = Address("127.0.0.1", 16124)
+    blocker = AtomixServer(addr, [addr], transport, stats_port=0)
+    await blocker.open()
+    taken = blocker.stats.port
+    try:
+        dup = AtomixServer(Address("127.0.0.1", 16125),
+                           [Address("127.0.0.1", 16125)],
+                           LocalTransport(registry), stats_port=taken)
+        with pytest.raises(OSError):
+            await dup.open()
+        assert dup.stats is None
+        assert not dup.server.is_open
+        # the raft address is free again: a fresh node can take it
+        ok = AtomixServer(Address("127.0.0.1", 16125),
+                          [Address("127.0.0.1", 16125)],
+                          LocalTransport(registry))
+        await ok.open()
+        await ok.close()
+    finally:
+        await blocker.close()
+
+
+def test_cli_stats_unreachable(capsys):
+    rc = cli._stats(type("A", (), {"address": "127.0.0.1:1",
+                                   "what": "stats"})())
+    assert rc == 1
+    assert "--stats-port" in capsys.readouterr().err

@@ -1,0 +1,154 @@
+"""Per-request tracing (utils/tracing.py): ring buffer semantics and
+end-to-end trace-id propagation through the wire protocol."""
+
+import asyncio
+
+import pytest
+
+from copycat_tpu.utils import tracing
+from copycat_tpu.utils.tracing import Tracer
+
+from helpers import async_test
+from raft_fixtures import Put, create_cluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """Every test starts and ends with the global tracer disabled+empty."""
+    tracing.disable()
+    tracing.TRACER.clear()
+    yield
+    tracing.disable()
+    tracing.TRACER.clear()
+
+
+def test_tracer_ring_buffer_evicts_oldest():
+    t = Tracer(capacity=3)
+    t.enabled = True
+    ids = [t.new_trace() for _ in range(5)]
+    for i, trace_id in enumerate(ids):
+        t.span(trace_id, "work", 0.0, 0.001 * (i + 1))
+    kept = t.traces()
+    assert len(kept) == 3
+    assert set(kept) == set(ids[-3:])
+    # a span for an evicted id re-admits it (remote ids arrive late)
+    t.span(ids[0], "late", 0.0, 0.5)
+    assert ids[0] in t.traces()
+
+
+def test_slowest_orders_by_total_wall():
+    t = Tracer()
+    a, b = t.new_trace(), t.new_trace()
+    t.span(a, "fast", 0.0, 0.001)
+    t.span(b, "slow.1", 0.0, 0.002)
+    t.span(b, "slow.2", 0.004, 0.010)  # total wall 10ms (first->last)
+    slow = t.slowest(2)
+    assert [s[0] for s in slow] == [b, a]
+    assert slow[0][1] == pytest.approx(10.0)
+    text = t.dump_slowest(2)
+    assert "slow.1" in text and "fast" in text
+    as_json = t.dump_slowest(2, as_json=True)
+    assert '"total_ms"' in as_json
+
+
+def test_dump_empty():
+    assert "no traces" in Tracer().dump_slowest()
+
+
+def test_span_cap_bounds_a_reused_trace_id():
+    # a peer replaying one id forever must not grow server memory
+    t = Tracer()
+    for i in range(10 * t.MAX_SPANS_PER_TRACE):
+        t.span(7, "replay", 0.0, 0.001)
+    assert len(t.spans_for(7)) == t.MAX_SPANS_PER_TRACE
+
+
+@async_test(timeout=60)
+async def test_trace_ids_survive_the_wire_roundtrip():
+    """A traced client submit yields server-side spans under the SAME
+    trace id — the id crossed the wire in the frame (LocalTransport
+    round-trips through the real serializer) and came back correlated."""
+    cluster = await create_cluster(3)
+    try:
+        client = await cluster.client()
+        tracing.enable()
+        # single command -> CommandRequest.trace
+        await client.submit(Put(key="a", value=1))
+        # same-turn pair -> one CommandBatchRequest.trace
+        await asyncio.gather(client.submit(Put(key="b", value=2)),
+                             client.submit(Put(key="c", value=3)))
+        tracing.disable()
+        traces = tracing.TRACER.traces()
+        assert traces, "no traces recorded"
+        client_traces = {tid for tid, spans in traces.items()
+                         if any(s.name == "client.submit" for s in spans)}
+        assert client_traces
+        for tid in client_traces:
+            names = {s.name for s in traces[tid]}
+            # server-side spans recorded under the CLIENT's id: the id
+            # survived request serialization and handler dispatch
+            assert "server.append" in names, names
+            assert "server.commit" in names, names
+        # the batch trace carries the batch size through to its spans
+        batch = [spans for spans in traces.values()
+                 for s in spans
+                 if s.name == "client.submit" and (s.meta or {}).get("n") == 2]
+        assert batch, "batch submit span missing"
+        # and the dump renders them
+        assert "server.commit" in tracing.TRACER.dump_slowest(5)
+    finally:
+        await cluster.close()
+
+
+@async_test(timeout=60)
+async def test_tracing_disabled_is_absent_from_the_wire():
+    """With tracing off (the default), requests carry trace=None, no
+    spans are recorded anywhere, and the hot path does no tracer work."""
+    cluster = await create_cluster(1)
+    try:
+        client = await cluster.client()
+        await client.submit(Put(key="x", value=1))
+        await asyncio.gather(client.submit(Put(key="y", value=2)),
+                             client.submit(Put(key="z", value=3)))
+        assert tracing.TRACER.traces() == {}
+        # a request built without a trace serializes/deserializes with
+        # the field absent-as-None (the wire shape tracing rides on)
+        from copycat_tpu.io.serializer import Serializer
+        from copycat_tpu.protocol import messages as msg
+        s = Serializer()
+        req = s.read(s.write(msg.CommandRequest(
+            session_id=1, seq=1, operation=None)))
+        assert req.trace is None
+        traced = s.read(s.write(msg.CommandBatchRequest(
+            session_id=1, entries=[], trace=41)))
+        assert traced.trace == 41
+    finally:
+        await cluster.close()
+
+
+@async_test(timeout=60)
+async def test_client_and_server_metrics_flow():
+    """The observability counters move under real traffic: client
+    submit latency histogram, server lane counters, transport frames."""
+    cluster = await create_cluster(3)
+    try:
+        client = await cluster.client()
+        for i in range(3):
+            await client.submit(Put(key=f"k{i}", value=i))
+        snap = client.metrics.snapshot()
+        assert snap["commands_submitted"] == 3
+        assert snap["submit_latency_ms"]["count"] == 3
+        leader = cluster.leader
+        stats = leader.stats_snapshot()
+        assert stats["role"] == "leader"
+        assert stats["raft"]["raft_is_leader"] == 1
+        assert stats["raft"]["raft_term"] >= 1
+        assert stats["raft"]["sessions_open"] >= 1
+        assert stats["raft"]["commands_single_lane"] == 3
+        assert stats["raft"]["applies_per_entry"] >= 3
+        # per-message transport accounting on the leader's endpoints
+        transport = stats.get("transport")
+        assert transport is not None
+        assert transport["frames_in"] > 0 and transport["bytes_in"] > 0
+    finally:
+        await cluster.close()
