@@ -136,6 +136,15 @@ POOL_BUDGETS = (tuple(int(x) for x in _budgets_env.split(","))
 # copycat_tpu.utils.profiling.summarize_trace).
 PROFILE_DIR = os.environ.get("COPYCAT_BENCH_PROFILE", "")
 
+# COPYCAT_BENCH_TELEMETRY=1: compile the round-8 device telemetry block
+# into the measured step (Config(telemetry=True)) — the A/B knob behind
+# PERF.md round 8's ≤2% ms/round acceptance bound. run_throughput
+# accumulates the telemetry deltas in the scan carry (an unread output
+# would be dead-code-eliminated and the A/B would measure nothing) and
+# reports the totals; run_host/run_session surface the engine's
+# device.* family in the --metrics-json artifact.
+TELEMETRY = os.environ.get("COPYCAT_BENCH_TELEMETRY", "0") == "1"
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -284,6 +293,7 @@ def run_throughput(scenario: str) -> dict:
                     applies_per_round=max(4, SUBMIT_SLOTS),
                     pool_budgets=POOL_BUDGETS,
                     timer_min=t_min, timer_max=t_max,
+                    telemetry=TELEMETRY,
                     resource=RESOURCE_CONFIGS.get(scenario, ResourceConfig()))
     key = jax.random.PRNGKey(0)
     key, init_key = jax.random.split(key)
@@ -315,13 +325,33 @@ def run_throughput(scenario: str) -> dict:
     # saturation catch-all (warned about below if hit).
     max_lat = LOG_SLOTS + (200 if nemesis else 34)
 
+    # Telemetry A/B (PERF.md round 8): the deltas must be CONSUMED or
+    # XLA dead-code-eliminates the whole block and the A/B measures the
+    # pre-change program. Accumulate them in the scan carry (per-group
+    # int32 sums — the same amortized-fetch shape the drivers use).
+    tel0 = None
+    if TELEMETRY:
+        from copycat_tpu.ops.apply import NUM_POOLS
+        from copycat_tpu.ops.consensus import DeviceTelemetry
+        zg = jnp.zeros((GROUPS,), jnp.int32)
+        tel0 = DeviceTelemetry(
+            elections_started=zg, leader_changes=zg, term_bumps=zg,
+            leaderless=zg, commit_advance=zg, commit_max=zg, term_max=zg,
+            leader_lane=zg, leader_term=zg,
+            applies=jnp.zeros((GROUPS, NUM_POOLS + 1), jnp.int32),
+            ring_occ_max=zg, submit_rejections=zg, vote_splits=zg,
+            events_drained=zg, events_dropped=zg)
+
     def run(state, key):
         def body(carry, victim):
-            state, key, applied_prev = carry
+            state, key, applied_prev, tel_acc = carry
             key, k = jax.random.split(key)
             dl = (victim_deliver(victim, GROUPS, PEERS) if nemesis
                   else deliver)
             state, out = step(state, submits, dl, k, config=config)
+            if TELEMETRY:
+                tel_acc = jax.tree.map(lambda a, d: a + d, tel_acc,
+                                       out.telemetry)
             if nemesis:
                 # Followers that fell beyond the ring window during an
                 # isolation can never be served by AppendEntries again;
@@ -346,30 +376,37 @@ def run_throughput(scenario: str) -> dict:
             # (out_valid reports are at-least-once across leader changes)
             applied_now = jnp.max(state.applied_index, axis=1)
             n = jnp.sum(applied_now - applied_prev, dtype=jnp.int32)
-            return (state, key, applied_now), (n, hist)
+            return (state, key, applied_now, tel_acc), (n, hist)
         applied0 = jnp.max(state.applied_index, axis=1)
-        (state, key, _), (counts, hists) = jax.lax.scan(
-            body, (state, key, applied0), victims,
+        (state, key, _, tel_acc), (counts, hists) = jax.lax.scan(
+            body, (state, key, applied0, tel0), victims,
             length=None if nemesis else ROUNDS)
-        return state, key, counts.sum(), hists.sum(axis=0)
+        return state, key, counts.sum(), hists.sum(axis=0), tel_acc
 
     run_jit = jax.jit(run)
-    state, key, n, hist = run_jit(state, key)
+    state, key, n, hist, tel = run_jit(state, key)
     jax.block_until_ready(n)
     log(f"bench[{scenario}]: warmup committed {int(n)} ops")
     best, best_dt, best_hist = 0.0, 1.0, np.asarray(hist)
 
     reps = []
+    tel_totals: dict = {}
     for rep in range(REPEATS):
         with xla_trace(PROFILE_DIR if rep == 0 else None):
             t0 = time.perf_counter()
-            state, key, n, hist = run_jit(state, key)
+            state, key, n, hist, tel = run_jit(state, key)
             n = int(jax.block_until_ready(n))
             dt = time.perf_counter() - t0
         ops = n / dt
         reps.append(ops)
         if ops >= best:
             best, best_dt, best_hist = ops, dt, np.asarray(hist)
+        if TELEMETRY:
+            for name in ("elections_started", "leader_changes",
+                         "leaderless", "commit_advance",
+                         "submit_rejections", "vote_splits"):
+                tel_totals[name] = tel_totals.get(name, 0) + int(
+                    np.asarray(getattr(tel, name), np.int64).sum())
         log(f"bench[{scenario}]: rep {rep}: {n} committed ops in {dt:.3f}s "
             f"-> {ops:,.0f} ops/sec ({dt / ROUNDS * 1e3:.2f} ms/round)")
     if best_hist[-1]:
@@ -387,7 +424,7 @@ def run_throughput(scenario: str) -> dict:
         f"({p99_r * ms_per_round:.2f} ms) at {ms_per_round:.2f} ms/round")
 
     suffix = "" if scenario == "counter" else f"_{scenario}"
-    return {
+    out = {
         "metric": (f"committed_linearizable_ops_per_sec_{GROUPS}_groups"
                    f"{suffix}"),
         "value": round(best, 1),
@@ -399,6 +436,10 @@ def run_throughput(scenario: str) -> dict:
         "p99_commit_latency_rounds": int(p99_r),
         **spread(reps),
     }
+    if TELEMETRY:
+        out["telemetry"] = True
+        out["device_telemetry"] = tel_totals
+    return out
 
 
 def run_host() -> dict:
@@ -425,6 +466,7 @@ def run_host() -> dict:
                                   applies_per_round=max(4, SUBMIT_SLOTS),
                                   pool_budgets=POOL_BUDGETS,
                                   resource=RESOURCE_CONFIGS["counter"],
+                                  telemetry=TELEMETRY,
                                   monotone_tag_accept=(
                                       mode in ("deep", "deepscan"))))
     per_group = int(os.environ.get(
@@ -477,6 +519,8 @@ def run_host() -> dict:
         out["p50_commit_latency_rounds"] = lat.percentile(50)
         out["p99_commit_latency_rounds"] = lat.percentile(99)
     METRICS_SNAPSHOTS["driver"] = rg.metrics.snapshot()
+    if rg.telemetry is not None:
+        METRICS_SNAPSHOTS["device"] = rg.device_snapshot()
     return out
 
 
@@ -499,6 +543,7 @@ def run_session() -> dict:
                                   applies_per_round=max(4, SUBMIT_SLOTS),
                                   pool_budgets=POOL_BUDGETS,
                                   resource=RESOURCE_CONFIGS["counter"],
+                                  telemetry=TELEMETRY,
                                   monotone_tag_accept=True))
     per_group = int(os.environ.get("COPYCAT_BENCH_HOST_BURST",
                                    str(SUBMIT_SLOTS * 8)))
@@ -541,6 +586,8 @@ def run_session() -> dict:
     expect = per_group * (len(reps) + 1)
     assert s0.result(q) == expect, (s0.result(q), expect)
     METRICS_SNAPSHOTS["driver"] = rg.metrics.snapshot()
+    if rg.telemetry is not None:
+        METRICS_SNAPSHOTS["device"] = rg.device_snapshot()
     return {
         "metric": f"session_committed_ops_per_sec_{GROUPS}_groups",
         "value": round(best, 1),

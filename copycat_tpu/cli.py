@@ -120,23 +120,109 @@ def server(argv: list[str] | None = None) -> None:
 # ---------------------------------------------------------------------------
 
 
+def _flatten_numeric(snap: dict, prefix: str = "") -> dict:
+    """Flatten a stats snapshot to dotted numeric keys (histogram
+    summaries expand to .count/.mean/.p50/.p99/.max; ``_gauge_keys``
+    hints are dropped). The watch renderer diffs these across polls."""
+    out: dict = {}
+    for k, v in snap.items():
+        if k == "_gauge_keys":
+            continue
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            if "count" in v and "mean" in v:  # histogram summary
+                for q in ("count", "mean", "p50", "p99", "max"):
+                    if q in v:
+                        out[f"{key}.{q}"] = v[q]
+            else:
+                out.update(_flatten_numeric(v, f"{key}."))
+        elif isinstance(v, bool):
+            out[key] = int(v)
+        elif isinstance(v, (int, float)):
+            out[key] = v
+    return out
+
+
+def _render_watch(snap: dict, prev: dict | None, dt: float) -> str:
+    """One watch frame: scalar header lines, then every numeric series
+    with its value and (from the second poll on) its delta/sec."""
+    import time as _time
+
+    lines = [f"--- {_time.strftime('%H:%M:%S')} ---"]
+    for k, v in snap.items():
+        if not isinstance(v, (dict, int, float)) or isinstance(v, bool):
+            lines.append(f"{k}: {v}")
+    flat = _flatten_numeric(snap)
+    for key in sorted(flat):
+        v = flat[key]
+        val = f"{v:.4f}".rstrip("0").rstrip(".") if isinstance(v, float) \
+            else str(v)
+        line = f"{key:<58} {val:>16}"
+        if prev is not None and key in prev and dt > 0:
+            rate = (flat[key] - prev[key]) / dt
+            if rate:
+                line += f"  {rate:+,.1f}/s"
+        lines.append(line)
+    return "\n".join(lines)
+
+
 def _stats(args: argparse.Namespace) -> int:
+    import time
+
     from .server.stats import fetch_stats
 
     path = {"stats": "/stats", "metrics": "/metrics",
-            "traces": "/traces.txt"}[args.what]
+            "traces": "/traces.txt", "flight": "/flight.txt"}[args.what]
+
+    def fetch() -> bytes | None:
+        try:
+            return asyncio.run(fetch_stats(args.address, path))
+        except (OSError, RuntimeError, asyncio.TimeoutError) as e:
+            print(f"copycat-tpu stats: cannot read {args.address}{path}: "
+                  f"{e}\n(is the server running with --stats-port?)",
+                  file=sys.stderr)
+            return None
+
+    watch = getattr(args, "watch", None)
+    if watch is None:
+        body = fetch()
+        if body is None:
+            return 1
+        if args.what in ("metrics", "traces", "flight"):
+            print(body.decode(), end="")
+        else:
+            print(json.dumps(json.loads(body), indent=2, sort_keys=True))
+        return 0
+
+    # --watch N: poll + re-render every N seconds; in stats mode each
+    # numeric series shows its delta/sec vs the previous poll (how fast
+    # is device.elections_started actually moving?). Ctrl-C exits.
+    prev: dict | None = None
+    prev_t = 0.0
+    failures = 0
     try:
-        body = asyncio.run(fetch_stats(args.address, path))
-    except (OSError, RuntimeError, asyncio.TimeoutError) as e:
-        print(f"copycat-tpu stats: cannot read {args.address}{path}: {e}\n"
-              f"(is the server running with --stats-port?)",
-              file=sys.stderr)
-        return 1
-    if args.what in ("metrics", "traces"):
-        print(body.decode(), end="")
-    else:
-        print(json.dumps(json.loads(body), indent=2, sort_keys=True))
-    return 0
+        while True:
+            body = fetch()
+            if body is None:
+                failures += 1
+                if failures >= 3:
+                    return 1
+            else:
+                failures = 0
+                now = time.monotonic()
+                if args.what == "stats":
+                    snap = json.loads(body)
+                    print(_render_watch(snap, prev, now - prev_t),
+                          flush=True)
+                    prev = _flatten_numeric(snap)
+                    prev_t = now
+                else:
+                    print(f"--- {time.strftime('%H:%M:%S')} "
+                          f"{args.address}{path} ---", flush=True)
+                    print(body.decode(), end="", flush=True)
+            time.sleep(watch)
+    except KeyboardInterrupt:
+        return 0
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -149,10 +235,16 @@ def main(argv: list[str] | None = None) -> None:
         "stats", help="read a running server's stats listener")
     stats.add_argument("address", metavar="host:port",
                        help="the server's --stats-port endpoint")
-    stats.add_argument("--what", choices=("stats", "metrics", "traces"),
+    stats.add_argument("--what",
+                       choices=("stats", "metrics", "traces", "flight"),
                        default="stats",
                        help="stats = JSON snapshot (default), metrics = "
-                            "Prometheus text, traces = slowest requests")
+                            "Prometheus text, traces = slowest requests, "
+                            "flight = device-plane flight recorder")
+    stats.add_argument("--watch", type=float, default=None, metavar="N",
+                       help="poll mode: re-render every N seconds; the "
+                            "JSON snapshot view shows delta/sec per "
+                            "numeric series between polls (Ctrl-C exits)")
 
     serve = sub.add_parser("serve", help="run a standalone server node")
     serve.add_argument("rest", nargs=argparse.REMAINDER)

@@ -102,6 +102,13 @@ class DeviceEngineConfig(NamedTuple):
     # uniform across the cluster, like every other engine shape. None =
     # all pools at their defaults (previous behavior).
     resource: Any = None
+    # Device-plane flight-recorder telemetry (models/telemetry.py):
+    # compiles the per-group telemetry block into the engine step and
+    # surfaces device.* metrics + /flight on the stats listener. Pure
+    # output — never changes the engine's state evolution, so it may
+    # differ across servers (a local observability choice, not a shape).
+    # COPYCAT_TELEMETRY=1 / COPYCAT_INVARIANTS also enable it per-env.
+    telemetry: bool = False
 
 
 class _Job:
@@ -410,8 +417,12 @@ class DeviceEngine:
                         f"DeviceEngineConfig.num_peers={cfg.num_peers} not "
                         f"divisible by the mesh 'peers' axis ({peer_shards})")
             from ..ops.consensus import Config
-            engine_cfg = (Config(resource=cfg.resource)
-                          if cfg.resource is not None else None)
+            engine_cfg = None
+            if cfg.resource is not None or cfg.telemetry:
+                engine_cfg = Config(
+                    telemetry=cfg.telemetry,
+                    **({"resource": cfg.resource}
+                       if cfg.resource is not None else {}))
             self._groups = RaftGroups(
                 cfg.capacity, cfg.num_peers, log_slots=cfg.log_slots,
                 submit_slots=cfg.submit_slots, seed=cfg.seed,

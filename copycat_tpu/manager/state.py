@@ -349,6 +349,14 @@ class ResourceManager(StateMachine):
                 m.gauge("device_groups_used").set(int(groups_used))
         out = m.snapshot()
         out["executor"] = self.executor_kind
+        # device-plane flight-recorder telemetry (models/telemetry.py):
+        # the engine's device.* family + invariant-monitor summary ride
+        # the manager section of /stats when telemetry is live
+        groups = getattr(self._engine, "_groups", None)
+        hub = getattr(groups, "telemetry", None)
+        if hub is not None:
+            out["device"] = hub.snapshot()
+            out["device"]["invariants"] = hub.monitor.summary()
         return out
 
     # -- session lifecycle fan-out (SURVEY.md §3.4) ------------------------

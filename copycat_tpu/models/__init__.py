@@ -7,6 +7,12 @@ one-``ResourceManager``-per-server design, ``AtomixReplica.java:374``).
 
 from .raft_groups import RaftGroups  # noqa: F401
 from .bulk import BulkDriver, BulkResult, drive_batch  # noqa: F401
+from .telemetry import (  # noqa: F401
+    DeviceTelemetryHub,
+    FlightRecorder,
+    InvariantMonitor,
+    InvariantViolation,
+)
 from .session_client import (  # noqa: F401
     BulkSession,
     BulkSessionClient,

@@ -258,8 +258,16 @@ class BulkDriver:
             return sub, idx, gi, slots
 
         def harvest(r: int, raw) -> None:
-            for leaf in (raw.out_valid, raw.out_tag, raw.out_result):
+            tel_leaves = (jax.tree.leaves(raw.telemetry)
+                          if rg.telemetry is not None
+                          and raw.telemetry is not None else ())
+            for leaf in (raw.out_valid, raw.out_tag, raw.out_result,
+                         *tel_leaves):
                 leaf.copy_to_host_async()
+            if tel_leaves:
+                rg.telemetry.ingest(
+                    jax.tree.map(np.asarray, raw.telemetry),
+                    rg.rounds + r)
             ov = np.asarray(raw.out_valid)
             if ov.any():
                 tags = np.asarray(raw.out_tag)[ov]
@@ -603,6 +611,12 @@ class BulkDriver:
         consts = ((None,) * 4 if multi
                   else tuple(map(_const, (op_s, a_s, b_s, c_s))))
         vals = (op_s, a_s, b_s, c_s)
+        # telemetry stash: per-round [G] delta blocks kept ON DEVICE and
+        # fetched with the accumulator harvest — the blind phase stays
+        # one transfer per drive even with the flight recorder on
+        tel_stash: list[Any] = []
+        rounds0 = rg.rounds
+        tel_ingested = 0
         # deliver_schedule(r) -> per-round delivery mask (already staged
         # for the engine's topology): the fault-injection seam — the
         # deep plane's liveness needs faults that HEAL, so a verdict/
@@ -628,10 +642,12 @@ class BulkDriver:
             (rg.state, resbuf, valbuf, rndbuf, evflag, out) = _deep(
                 rg.state, resbuf, valbuf, rndbuf, evflag, base_dev,
                 np.int32(r), sub, dl, key)
-            # keep only the ev leaves alive — retaining the whole
-            # StepOutputs would pin every round's out arrays on device
+            # keep only the ev (+ telemetry) leaves alive — retaining the
+            # whole StepOutputs would pin every round's out arrays on device
             ev_stash.append((out.ev_seq, out.ev_code, out.ev_target,
                              out.ev_arg, out.ev_valid))
+            if rg.telemetry is not None and out.telemetry is not None:
+                tel_stash.append(out.telemetry)
             r += 1
 
         _idle = (np.zeros((G, 1), np.int32), np.zeros((G, S), bool),
@@ -639,10 +655,21 @@ class BulkDriver:
                  else (np.int32(0),) * 4)
 
         def harvest() -> None:
-            """ONE fetch of the [G,B] accumulators (+ events, rare)."""
-            nonlocal evflag
-            res_np, val_np, rnd_np, ev = rg._fetch_acc(
-                (resbuf, valbuf, rndbuf, evflag))
+            """ONE fetch of the [G,B] accumulators (+ telemetry, + the
+            rare event leaves)."""
+            nonlocal evflag, tel_ingested
+            res_np, val_np, rnd_np, ev, tels = rg._fetch_acc(
+                (resbuf, valbuf, rndbuf, evflag, tel_stash))
+            for tel in tels:
+                if np.asarray(tel.elections_started).ndim == 2:
+                    w = int(np.asarray(tel.elections_started).shape[0])
+                    rg.telemetry.ingest_stacked(
+                        tel, rounds0 + tel_ingested)
+                    tel_ingested += w
+                else:
+                    rg.telemetry.ingest(tel, rounds0 + tel_ingested)
+                    tel_ingested += 1
+            tel_stash.clear()
             colm = np.arange(Bpad)[None, :] < counts[:, None]
             resolved[:] = val_np[seg_groups][colm]
             results[:] = res_np[seg_groups][colm]
@@ -708,12 +735,14 @@ class BulkDriver:
                 rg.config, onehot=rg.mesh is not None,
                 donate=jax.default_backend() != "cpu")
             rg._key, key = jax.random.split(rg._key)
-            (rg.state, resbuf, valbuf, rndbuf, evflag, evs) = _scan(
+            (rg.state, resbuf, valbuf, rndbuf, evflag, evs, tels) = _scan(
                 rg.state, resbuf, valbuf, rndbuf, evflag, base_dev,
                 Submits(opcode=op_w, a=a_w, b=b_w, c=c_w, tag=tagl_w,
                         valid=valid_w), deliver, key)
             r = W_total
             ev_stash.append(evs)   # stacked [W, ...] leaves
+            if rg.telemetry is not None and tels is not None:
+                tel_stash.append(tels)  # stacked [W, G] leaves
         else:
             for w in range(windows):
                 in_w = (rank >= w * S) & (rank < (w + 1) * S)

@@ -95,6 +95,16 @@ class RaftGroups:
         self.log_slots = log_slots
         self.submit_slots = submit_slots
         self.config = config or Config()
+        # Environment opt-in for the device-plane flight recorder
+        # (COPYCAT_TELEMETRY=1 / COPYCAT_INVARIANTS=observe|strict):
+        # flips the static knob BEFORE any program is compiled so CI can
+        # run the whole nemesis suite under strict invariants without
+        # touching each test's Config. Telemetry never changes the
+        # state evolution (it is pure output), so this is safe to apply
+        # to any engine.
+        from .telemetry import telemetry_env_enabled
+        if not self.config.telemetry and telemetry_env_enabled():
+            self.config = self.config._replace(telemetry=True)
         self.mesh = mesh
         members = None
         if voters is not None:
@@ -170,6 +180,14 @@ class RaftGroups:
         # first-class ops/sec + latency metrics (SURVEY.md §5.5)
         from ..utils.metrics import MetricsRegistry
         self.metrics = MetricsRegistry()
+        # device-plane flight recorder: hub folds the step's telemetry
+        # deltas into the device.* metric family, the flight ring, and
+        # the online invariant monitor (models/telemetry.py)
+        if self.config.telemetry:
+            from .telemetry import DeviceTelemetryHub
+            self.telemetry: Any = DeviceTelemetryHub(num_groups)
+        else:
+            self.telemetry = None
         self.clock = 0                       # mirrors the device logical clock
         # session events per group: list of (seq, code, target, arg);
         # deduped by absolute seq (ring re-delivers across leader changes)
@@ -698,6 +716,8 @@ class RaftGroups:
                  int(submits.tag[g, s])))
 
     def _harvest(self, out: StepOutputs) -> None:
+        if self.telemetry is not None and out.telemetry is not None:
+            self.telemetry.ingest(out.telemetry, self.rounds)
         self.clock = int(np.asarray(out.clock).max(initial=self.clock))
         lt = np.asarray(out.leader_term)
         rose = self._placements and bool((lt > self._leader_term).any())
@@ -992,6 +1012,22 @@ class RaftGroups:
         return [p for p in range(self.num_peers) if (mask >> p) & 1]
 
     # -- inspection --------------------------------------------------------
+
+    def device_snapshot(self) -> dict:
+        """The ``device.*`` telemetry family as a mergeable snapshot
+        dict (empty when telemetry is off). This is what ``/stats``
+        embeds, ``bench.py --metrics-json`` records, and
+        ``merge_snapshots`` folds across shards/processes."""
+        if self.telemetry is None:
+            return {}
+        return self.telemetry.snapshot()
+
+    def merged_device_snapshot(self) -> dict:
+        """Cluster-wide ``device.*`` snapshot. Identity on one process;
+        the multihost subclass allgathers every process's local family
+        and folds them with ``merge_snapshots`` (counters sum, gauges
+        max) so elections/commit-advance attribute per shard."""
+        return self.device_snapshot()
 
     def leader(self, group: int) -> int:
         role = np.asarray(self.state.role[group])

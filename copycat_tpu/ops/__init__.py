@@ -10,6 +10,7 @@ from .consensus import (  # noqa: F401
     CANDIDATE,
     FOLLOWER,
     LEADER,
+    DeviceTelemetry,
     RaftState,
     StepOutputs,
     Submits,

@@ -37,7 +37,7 @@ and stop receiving (snapshot install catches them up — see
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -53,9 +53,49 @@ from .apply import (
     apply_window,
     drain_events,
     init_resources,
+    pool_of,
 )
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+
+
+class DeviceTelemetry(NamedTuple):
+    """Per-group on-device telemetry deltas for ONE consensus round.
+
+    Every leaf is ``[G]`` i32 (``applies`` is ``[G, NUM_POOLS+1]``) —
+    deliberately group-leading and group-local: on a group-sharded mesh
+    each value reduces only over the peer/slot axes of its own shard, so
+    the telemetry block compiles to ZERO cross-device collectives (the
+    same rule the deep accumulators follow — a scalar total here would
+    be the one all-reduce in the program). The host sums over G.
+
+    Derived entirely from values the step already computes — no extra
+    RNG, no state writes — so the telemetry-off step is bit-identical
+    to a tree without the block (``Config.telemetry`` is static; off
+    compiles it out entirely and ``StepOutputs.telemetry`` is None).
+    """
+
+    elections_started: jnp.ndarray  # lanes whose timer fired this round
+    leader_changes: jnp.ndarray     # election won by a lane != round-start
+    #                                 leader (or the group was leaderless)
+    term_bumps: jnp.ndarray         # delta of the group-max term
+    leaderless: jnp.ndarray         # 1 iff no leader at round start
+    commit_advance: jnp.ndarray     # delta of the group-max commit index
+    commit_max: jnp.ndarray         # post-round max commit index (monotone
+    #                                 — the invariant monitor's witness)
+    term_max: jnp.ndarray           # post-round max term over lanes
+    leader_lane: jnp.ndarray        # post-round leader lane (-1 none) —
+    leader_term: jnp.ndarray        # paired with its term (-1 none): the
+    #                                 watch-list's ≤1-leader-per-term feed
+    applies: jnp.ndarray            # [G, NUM_POOLS+1] entries applied by
+    #                                 the reporting lane, by resource pool
+    #                                 (last column = NoOp/config entries)
+    ring_occ_max: jnp.ndarray       # max over lanes of last-applied
+    submit_rejections: jnp.ndarray  # valid slots rejected (backpressure,
+    #                                 lease/tag gate) — requeued, not lost
+    vote_splits: jnp.ndarray        # 1 iff candidates existed and nobody won
+    events_drained: jnp.ndarray     # leader-lane outbox events popped
+    events_dropped: jnp.ndarray     # outbox ring drop-oldest overwrites
 
 
 class RaftState(NamedTuple):
@@ -171,6 +211,11 @@ class StepOutputs(NamedTuple):
     # instead of requeueing — a forever-retrying config op would block
     # its group's whole queue behind the FIFO suffix-reject.
     refused: jnp.ndarray        # [G,S] bool
+    # Per-group telemetry deltas (:class:`DeviceTelemetry`) when
+    # ``Config.telemetry`` — None otherwise (a None pytree subtree costs
+    # nothing to carry, stack, or fetch). Trailing with a default so
+    # every existing positional constructor stays valid.
+    telemetry: Any = None
 
 
 class Config(NamedTuple):
@@ -231,6 +276,18 @@ class Config(NamedTuple):
     # Queue-managed submits (retries of old tags) are incompatible;
     # RaftGroups refuses them on monotone engines.
     monotone_tag_accept: bool = False
+    # Device-plane flight-recorder telemetry (docs/OBSERVABILITY.md §
+    # device plane): compile a :class:`DeviceTelemetry` block of per-
+    # group reductions into the step, returned as
+    # ``StepOutputs.telemetry`` and fetched with the existing output
+    # transfer (amortized — the hot loop stays one transfer per drive).
+    # Derived purely from values the step already computes: no extra
+    # randomness, no state writes — OFF compiles the exact pre-telemetry
+    # program and the step's state evolution is bit-identical either
+    # way (tested in tests/test_device_telemetry.py; A/B in PERF.md
+    # round 8). The host side (device.* metrics, flight recorder,
+    # invariant monitors) lives in models/telemetry.py.
+    telemetry: bool = False
 
 
 def init_state(num_groups: int, num_peers: int, log_slots: int,
@@ -975,6 +1032,59 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
         log_tag=log_tag2, resources=resources,
         lease=jnp.broadcast_to(lease_g[:, None], (G, P)),
         member=member2)
+
+    # ---- telemetry block (compiled in only under Config.telemetry) -------
+    # Pure reductions over values already computed above: no new RNG, no
+    # state writes — the off path is the exact pre-telemetry program.
+    # Every reduction stays per-group ([G]-leading) so a group-sharded
+    # mesh compiles it without cross-device collectives.
+    tel = None
+    if config.telemetry:
+        i32 = jnp.int32
+        term_max = jnp.max(new_state.term, axis=1)
+        commit_max = jnp.max(commit2, axis=1)
+        post_lead_term = jnp.where(role_f == LEADER, new_state.term, -1)
+        post_lead = jnp.argmax(post_lead_term, axis=1).astype(i32)
+        post_term = jnp.max(post_lead_term, axis=1)
+        rejected = submits.valid & ~accepted
+        if dyn:
+            rejected = rejected & ~refused
+        # entries applied by the reporting lane, bucketed by pool (the
+        # commit-stream view — counting all P lanes would overstate by P)
+        pool_w = pool_of(op_w)                               # [G,P,A]
+        pool_oh = pool_w[..., None] == jnp.arange(NUM_POOLS + 1,
+                                                  dtype=i32)  # [G,P,A,K]
+        rep_adm = (rep_oh[:, :, None] & admitted)[..., None]
+        applies_by_pool = jnp.sum(pool_oh & rep_adm, axis=(1, 2),
+                                  dtype=i32)                 # [G,K]
+        # outbox accounting: heads advance by drain pops or drop-oldest
+        # overwrites; lanes evolve in lockstep, so the max lane is the
+        # group's truth
+        pops = ev_ok.sum(axis=-1, dtype=i32)                 # [G,P]
+        head_adv = resources.ev_head - state.resources.ev_head
+        tel = DeviceTelemetry(
+            elections_started=timeout.sum(axis=1, dtype=i32),
+            leader_changes=jnp.sum(
+                won & ((peer_ids[None, :] != lead[:, None])
+                       | ~active[:, None]), axis=1, dtype=i32),
+            term_bumps=term_max - jnp.max(state.term, axis=1),
+            leaderless=(~active).astype(i32),
+            commit_advance=commit_max
+            - jnp.max(state.commit_index, axis=1),
+            commit_max=commit_max,
+            term_max=term_max,
+            leader_lane=jnp.where(post_term >= 0, post_lead, -1),
+            leader_term=post_term,
+            applies=applies_by_pool,
+            ring_occ_max=jnp.max(last_f - applied, axis=1),
+            submit_rejections=rejected.sum(axis=1, dtype=i32),
+            vote_splits=(jnp.any(cand_mask, axis=1)
+                         & ~jnp.any(won, axis=1)).astype(i32),
+            events_drained=lead_ev.sum(axis=1, dtype=i32),
+            events_dropped=jnp.max(
+                jnp.maximum(head_adv - pops, 0), axis=1),
+        )
+
     outputs = StepOutputs(
         accepted=accepted, out_valid=out_valid, out_tag=out_tag,
         out_result=out_result, out_latency=out_latency, leader=lead,
@@ -989,7 +1099,8 @@ def step(state: RaftState, submits: Submits, deliver: jnp.ndarray,
         out_term=jnp.where(out_valid, rep3(ga(log_term2)), 0),
         leader_term=jnp.max(
             jnp.where(role_f == LEADER, new_state.term, -1), axis=1),
-        refused=refused if dyn else jnp.zeros_like(submits.valid))
+        refused=refused if dyn else jnp.zeros_like(submits.valid),
+        telemetry=tel)
     return new_state, outputs
 
 
@@ -1094,11 +1205,14 @@ def deep_scan(state: RaftState, resbuf: jnp.ndarray, valbuf: jnp.ndarray,
         st, rb, vb, nb, ev, out = _deep_accumulate(
             st, rb, vb, nb, ev, base, rnd, out,
             out.out_tag.shape[0], rb.shape[1], onehot)
-        return (st, rb, vb, nb, ev), (out.ev_seq, out.ev_code,
-                                      out.ev_target, out.ev_arg,
-                                      out.ev_valid)
+        return (st, rb, vb, nb, ev), ((out.ev_seq, out.ev_code,
+                                       out.ev_target, out.ev_arg,
+                                       out.ev_valid), out.telemetry)
 
-    (state, resbuf, valbuf, rndbuf, evflag), evs = jax.lax.scan(
+    (state, resbuf, valbuf, rndbuf, evflag), (evs, tels) = jax.lax.scan(
         body, (state, resbuf, valbuf, rndbuf, evflag),
         (submits_w, rnds, keys))
-    return state, resbuf, valbuf, rndbuf, evflag, evs
+    # ``tels`` is the stacked [W, G] telemetry of the whole blind phase
+    # (None when Config.telemetry is off) — fetched with the drive's one
+    # accumulator harvest, never per round.
+    return state, resbuf, valbuf, rndbuf, evflag, evs, tels

@@ -243,6 +243,52 @@ class MultiHostRaftGroups(RaftGroups):
     def _any_across(self, mine: bool) -> bool:
         return bool(self._gather_flags(mine).any())
 
+    # -- device-plane telemetry (models/telemetry.py) ---------------------
+
+    def merged_device_snapshot(self) -> dict:
+        """Cluster-wide ``device.*`` family: allgather each process's
+        local snapshot (the hub eagerly registers every key, so the key
+        sets agree) and fold with ``merge_snapshots`` — counters sum
+        across shards, gauges take the max except the per-shard-additive
+        ones (``ADDITIVE_GAUGES``: commit total, leaderless count),
+        which sum. COLLECTIVE: every process must call it together
+        (same lockstep contract as step_round)."""
+        from jax.experimental import multihost_utils
+
+        from ..utils.metrics import merge_snapshots
+
+        local = self.device_snapshot()
+        # The enablement decision must itself be COLLECTIVE: telemetry
+        # is a per-process choice (env opt-in), and a telemetry-off
+        # process returning early while its peers enter the value
+        # allgather would hang the cluster. Every process first agrees
+        # whether ALL of them have the family; if any lacks it, all
+        # return {} together.
+        have = np.asarray(
+            multihost_utils.process_allgather(np.asarray(bool(local))))
+        if not have.all():
+            return {}
+        gauge_keys = local.get("_gauge_keys", [])
+        keys = sorted(k for k, v in local.items()
+                      if k != "_gauge_keys" and not isinstance(v, dict))
+        vals = np.asarray([float(local[k]) for k in keys], np.float64)
+        gathered = np.asarray(multihost_utils.process_allgather(vals))
+        snaps = []
+        for p in range(gathered.shape[0]):
+            snap: dict = {k: gathered[p, i] for i, k in enumerate(keys)}
+            snap["_gauge_keys"] = list(gauge_keys)
+            snaps.append(snap)
+        out = merge_snapshots(snaps)
+        # gauges that are sums over each process's DISJOINT group block
+        # (commit total, leaderless count) add across shards; the
+        # merge_snapshots gauge default (max) would report only the
+        # worst shard
+        from ..models.telemetry import ADDITIVE_GAUGES
+        for k in ADDITIVE_GAUGES:
+            if k in keys:
+                out[k] = float(gathered[:, keys.index(k)].sum())
+        return out
+
     # -- local views -------------------------------------------------------
 
     def leader(self, group: int) -> int:

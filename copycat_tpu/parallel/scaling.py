@@ -98,10 +98,14 @@ def _measure_bulk(n_devices: int, devices) -> dict:
     from ..models.raft_groups import RaftGroups
     from ..ops import apply as ap
     from ..ops.consensus import Config
+    from ..utils.metrics import merge_snapshots
 
     mesh = Mesh(np.asarray(devices[:n_devices]), ("groups",))
+    # telemetry ON here on purpose: the deep_step/deep_scan censuses
+    # below then also verify the round-8 telemetry block compiles
+    # without cross-device collectives (its reductions are per-group)
     config = Config(append_window=8, applies_per_round=8,
-                    monotone_tag_accept=True)
+                    monotone_tag_accept=True, telemetry=True)
     rg = RaftGroups(GROUPS, PEERS, log_slots=32, submit_slots=8,
                     mesh=mesh, config=config)
     rg.wait_for_leaders()
@@ -118,12 +122,23 @@ def _measure_bulk(n_devices: int, devices) -> dict:
     # round 5: the fused scan program is a distinct compiled module —
     # its zero-collective property is verified separately, not inherited
     scan_collectives = _deep_scan_census(n_devices, devices, config)
+    # Per-DEVICE telemetry attribution (round 8): the hub's per-group
+    # cumulative arrays split into each device's contiguous group block
+    # — elections / leader changes / commit advance per shard — and the
+    # shard snapshots fold back into one cluster view with the same
+    # merge_snapshots the multihost roll-up uses.
+    shard_snaps = rg.telemetry.shard_snapshots(n_devices)
+    merged = merge_snapshots(
+        [{k: v for k, v in s.items() if k.startswith("device.")}
+         for s in shard_snaps])
     return {"devices": n_devices,
             "client_visible_ops_per_sec": round(g.size / dt),
             "drive_rounds": res.rounds,
             "warmup_s": round(warm_s, 1),
             "collectives": collectives,
-            "scan_collectives": scan_collectives}
+            "scan_collectives": scan_collectives,
+            "telemetry_per_shard": shard_snaps,
+            "telemetry_merged": merged}
 
 
 def _deep_census(n_devices: int, devices, config) -> dict:
@@ -340,6 +355,15 @@ def main() -> None:
         "(Same oversubscription caveat: virtual devices share this host's",
         "core, so ops/sec across device counts measures scheduler overhead",
         "only; zero collectives is the portable witness.)",
+        "",
+        "The bulk rows run with the round-8 device telemetry block ON",
+        "(`Config(telemetry=True)`), so the deep_step/deep_scan censuses",
+        "above also witness that the telemetry reductions stay per-group",
+        "(zero collectives), and each row's JSON carries",
+        "`telemetry_per_shard` — elections / leader changes / commit",
+        "advance attributed to every device's group block — plus",
+        "`telemetry_merged`, the same shards folded back through",
+        "`merge_snapshots` (the multihost roll-up idiom).",
         "",
     ]
     with open("MULTICHIP_SCALING.md", "w") as f:

@@ -16,6 +16,11 @@ Routes:
   resource manager's under ``copycat_manager_*``.
 - ``/traces`` — JSON dump of the slowest traced requests
   (``utils/tracing.py``); ``/traces.txt`` for the human rendering.
+- ``/flight`` — the device-plane flight recorder (telemetry spikes,
+  injected faults, invariant violations in one fault-correlated ring —
+  ``models/telemetry.py``); ``/flight.txt`` for the human rendering.
+  Active when the server runs the TPU executor with telemetry on
+  (``COPYCAT_TELEMETRY=1`` / ``DeviceEngineConfig(telemetry=True)``).
 
 Enable with ``AtomixServer(..., stats_port=N)`` /
 ``copycat-server --stats-port N``; read with ``copycat-tpu stats
@@ -117,13 +122,37 @@ class StatsListener:
                 "application/json"
         if path == "/traces.txt":
             return TRACER.dump_slowest(20).encode(), "text/plain"
+        if path == "/flight":
+            hub = self._device_hub()
+            body = (hub.flight.render_json() if hub is not None
+                    else json.dumps({"events": [], "note":
+                                     "device-plane telemetry disabled "
+                                     "(COPYCAT_TELEMETRY=1 or "
+                                     "DeviceEngineConfig(telemetry=True))"}))
+            return body.encode(), "application/json"
+        if path == "/flight.txt":
+            hub = self._device_hub()
+            body = (hub.flight.render_text() if hub is not None
+                    else "device-plane telemetry disabled\n")
+            return body.encode(), "text/plain"
         if path in ("/", "/stats", "/stats.json"):
             return json.dumps(self._raft.stats_snapshot()).encode(), \
                 "application/json"
         return (json.dumps({"error": f"unknown path {path}",
                             "routes": ["/stats", "/metrics", "/traces",
-                                       "/traces.txt"]}).encode(),
+                                       "/traces.txt", "/flight",
+                                       "/flight.txt"]}).encode(),
                 "application/json")
+
+    def _device_hub(self):
+        """The device engine's telemetry hub, when the server runs the
+        TPU executor with an instantiated, telemetry-enabled engine.
+        Reads the raw ``_engine`` attribute — the ``device_engine``
+        property builds the engine lazily, and a stats scrape must
+        never trigger a multi-second jit compile."""
+        engine = getattr(self._raft.state_machine, "_engine", None)
+        groups = getattr(engine, "_groups", None)
+        return getattr(groups, "telemetry", None)
 
     def _prometheus(self) -> str:
         self._raft.stats_snapshot()  # refresh the lazy gauges
@@ -136,6 +165,11 @@ class StatsListener:
         if isinstance(manager_metrics, MetricsRegistry):
             out.append(manager_metrics.render_prometheus(
                 namespace="copycat_manager"))
+        hub = self._device_hub()
+        if hub is not None:
+            # device.* sanitizes to copycat_device_* — the device-plane
+            # family next to the host families in one scrape
+            out.append(hub.registry.render_prometheus(namespace="copycat"))
         return "".join(out)
 
 

@@ -67,3 +67,10 @@ class Nemesis:
         import jax.numpy as jnp
 
         self._rg.deliver = jnp.asarray(self._mask(fault))
+        # fault-correlated flight recorder (models/telemetry.py): the
+        # injected fault lands in the SAME bounded event ring as the
+        # device telemetry, so an election spike and the partition that
+        # caused it sit adjacent in one /flight dump
+        hub = getattr(self._rg, "telemetry", None)
+        if hub is not None:
+            hub.flight.record("fault", self._rg.rounds, fault=fault)

@@ -127,14 +127,31 @@ def _invoke_lock(rec: HistoryRecorder, g: int, rng) -> None:
         rec.invoke(g, ap.OP_LOCK_RELEASE, ("release", who), a=who)
 
 
+def _telemetry_summary(rg) -> dict:
+    """Final device.* telemetry + invariant-monitor verdict for the JSON
+    artifact (fields documented in LINEARIZABILITY.md). The monitor ran
+    on EVERY fetched round of the run, so violations==0 here is an
+    online safety witness alongside the offline Wing & Gong check."""
+    hub = getattr(rg, "telemetry", None)
+    if hub is None:
+        return {}
+    out = {k: v for k, v in hub.snapshot().items()
+           if k.startswith("device.") and not isinstance(v, dict)}
+    out["invariants"] = hub.monitor.summary()
+    return out
+
+
 def run_verdict() -> dict:
+    from ..ops.consensus import Config
+
     t0 = time.time()
     if CHURN:
-        from ..ops.consensus import Config
         rg = RaftGroups(GROUPS, 5, log_slots=64, submit_slots=4, seed=SEED,
-                        config=Config(dynamic_membership=True), voters=3)
+                        config=Config(dynamic_membership=True,
+                                      telemetry=True), voters=3)
     else:
-        rg = RaftGroups(GROUPS, 3, log_slots=64, submit_slots=4, seed=SEED)
+        rg = RaftGroups(GROUPS, 3, log_slots=64, submit_slots=4, seed=SEED,
+                        config=Config(telemetry=True))
     rg.wait_for_leaders()
     rec = HistoryRecorder(rg)
     nemesis = Nemesis(rg, seed=SEED + 1, period=12)
@@ -244,6 +261,7 @@ def run_verdict() -> dict:
         "incomplete_ops": len(rec._pending),
         "wall_s": round(time.time() - t0, 1),
         "seed": SEED,
+        "device_telemetry": _telemetry_summary(rg),
     }
     if CHURN:
         result["membership_changes_applied"] = cfg_applied
@@ -276,7 +294,8 @@ def run_deep_verdict() -> dict:
     t0 = time.time()
     rg = RaftGroups(DEEP_GROUPS, 3, log_slots=64, submit_slots=4,
                     seed=SEED + 10,
-                    config=Config(monotone_tag_accept=True))
+                    config=Config(monotone_tag_accept=True,
+                                  telemetry=True))
     rg.wait_for_leaders()
     driver = BulkDriver(rg)
     rng = np.random.default_rng(SEED + 11)
@@ -458,6 +477,7 @@ def run_deep_verdict() -> dict:
         "search_nodes": nodes,
         "wall_s": round(time.time() - t0, 1),
         "seed": SEED,
+        "device_telemetry": _telemetry_summary(rg),
     }
 
 
@@ -502,6 +522,35 @@ def _write_artifact(result: dict) -> None:
         "into a partitioned leader) may linearize at any point or"
         " never — exactly a",
         "Jepsen client's crashed-request semantics.",
+        "",
+        "## Device telemetry fields (round 8)",
+        "",
+        "`device_telemetry` (and `deep_plane.device_telemetry`) embed the"
+        " run's final",
+        "device-plane flight-recorder counters"
+        " (docs/OBSERVABILITY.md § device plane):",
+        "`device.elections_started`, `device.leader_changes`,"
+        " `device.term_bumps`,",
+        "`device.leaderless_rounds` (group-rounds without a leader),",
+        "`device.commit_advance`, `device.submit_rejections`"
+        " (backpressure/lease-gate",
+        "requeues), `device.vote_splits`, `device.events_drained` /"
+        " `_dropped`, and",
+        "`device.applies{pool=...}` — all accumulated from the jitted"
+        " step's on-device",
+        "reductions across every round of the run. `invariants` is the"
+        " ONLINE monitor's",
+        "verdict: `{mode, violations, watched_groups, leaderless_max}` —"
+        " per-fetch checks",
+        "of commit-total/per-group commit monotonicity, per-group leader-"
+        "term",
+        "monotonicity, the leaderless-fraction bound, and a sampled"
+        " ≤1-leader-per-term",
+        "watch-list. `violations: 0` means no fetched round ever"
+        " contradicted Raft's",
+        "safety claims while the nemesis ran; under"
+        " `COPYCAT_INVARIANTS=strict` the run",
+        "would have aborted at the first violation instead.",
         "",
     ]
     if "deep_plane" in result:
