@@ -171,14 +171,19 @@ def _stats(args: argparse.Namespace) -> int:
 
     from .server.stats import fetch_stats
 
+    # ``all`` renders every surface in one shot (JSON snapshot first — it
+    # carries all registries, the read-lane family included — then the
+    # Prometheus text and the flight ring); its watch mode polls /stats,
+    # whose delta renderer already covers every numeric series.
     path = {"stats": "/stats", "metrics": "/metrics",
-            "traces": "/traces.txt", "flight": "/flight.txt"}[args.what]
+            "traces": "/traces.txt", "flight": "/flight.txt",
+            "all": "/stats"}[args.what]
 
-    def fetch() -> bytes | None:
+    def fetch(p: str = path) -> bytes | None:
         try:
-            return asyncio.run(fetch_stats(args.address, path))
+            return asyncio.run(fetch_stats(args.address, p))
         except (OSError, RuntimeError, asyncio.TimeoutError) as e:
-            print(f"copycat-tpu stats: cannot read {args.address}{path}: "
+            print(f"copycat-tpu stats: cannot read {args.address}{p}: "
                   f"{e}\n(is the server running with --stats-port?)",
                   file=sys.stderr)
             return None
@@ -188,7 +193,17 @@ def _stats(args: argparse.Namespace) -> int:
         body = fetch()
         if body is None:
             return 1
-        if args.what in ("metrics", "traces", "flight"):
+        if args.what == "all":
+            print("=== stats ===")
+            print(json.dumps(json.loads(body), indent=2, sort_keys=True))
+            for title, p in (("metrics", "/metrics"),
+                             ("traces", "/traces.txt"),
+                             ("flight", "/flight.txt")):
+                extra = fetch(p)
+                if extra is not None:
+                    print(f"=== {title} ===")
+                    print(extra.decode(), end="")
+        elif args.what in ("metrics", "traces", "flight"):
             print(body.decode(), end="")
         else:
             print(json.dumps(json.loads(body), indent=2, sort_keys=True))
@@ -210,7 +225,7 @@ def _stats(args: argparse.Namespace) -> int:
             else:
                 failures = 0
                 now = time.monotonic()
-                if args.what == "stats":
+                if args.what in ("stats", "all"):
                     snap = json.loads(body)
                     print(_render_watch(snap, prev, now - prev_t),
                           flush=True)
@@ -236,11 +251,14 @@ def main(argv: list[str] | None = None) -> None:
     stats.add_argument("address", metavar="host:port",
                        help="the server's --stats-port endpoint")
     stats.add_argument("--what",
-                       choices=("stats", "metrics", "traces", "flight"),
+                       choices=("stats", "metrics", "traces", "flight",
+                                "all"),
                        default="stats",
                        help="stats = JSON snapshot (default), metrics = "
                             "Prometheus text, traces = slowest requests, "
-                            "flight = device-plane flight recorder")
+                            "flight = device-plane flight recorder, "
+                            "all = every surface in one shot (watch mode "
+                            "polls the JSON snapshot's delta view)")
     stats.add_argument("--watch", type=float, default=None, metavar="N",
                        help="poll mode: re-render every N seconds; the "
                             "JSON snapshot view shows delta/sec per "

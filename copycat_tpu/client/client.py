@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import os
 import random
 import time
 import uuid
@@ -167,6 +168,17 @@ class RaftClient(Managed):
         # amortized over the whole batch.
         self._pending_queries: dict[str, list] = {}
         self._query_flush_scheduled = False
+        # Follower read scale-out: SEQUENTIAL/CAUSAL reads round-robin
+        # across ALL members instead of pinning the session connection
+        # (usually the leader) — any server may serve them at or after
+        # the client's index (the server-side client-index wait), so
+        # read throughput scales with replicas. Leader fallback on lag
+        # refusal / unreachable follower. COPYCAT_CLIENT_FOLLOWER_READS=0
+        # restores leader-pinned reads (the scale-out A/B knob).
+        self._follower_reads = os.environ.get(
+            "COPYCAT_CLIENT_FOLLOWER_READS", "1") != "0"
+        self._read_connections: dict[Address, Connection] = {}
+        self._read_rr = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -196,6 +208,7 @@ class RaftClient(Managed):
         self._session._closed()
         await self._client.close()
         self._connection = None
+        self._read_connections.clear()
 
     # -- connection management --------------------------------------------
 
@@ -276,6 +289,44 @@ class RaftClient(Managed):
                     continue
             return response
         raise msg.ProtocolError(msg.NO_LEADER, f"no leader after retries: {last}")
+
+    async def _request_read(self, request: Any) -> Any:
+        """Send one SEQUENTIAL/CAUSAL read to the next server round-robin
+        (followers included — they serve at or after the client's index
+        via the server-side applied wait), falling back to the routed
+        leader path when a follower is unreachable, lagging behind the
+        client's index, or refuses to serve. Read connections are cached
+        separately from the session connection so follower reads never
+        steal the event/command channel."""
+        members = list(self.members)
+        count = len(members)
+        for _ in range(count):
+            address = members[self._read_rr % count]
+            self._read_rr += 1
+            conn = self._read_connections.get(address)
+            if conn is None or conn.closed:
+                try:
+                    conn = await self._client.connect(address)
+                except (TransportError, OSError):
+                    continue
+                self._read_connections[address] = conn
+            try:
+                response = await asyncio.wait_for(
+                    conn.send(request), self.session_timeout)
+            except (TransportError, OSError, asyncio.TimeoutError):
+                self._read_connections.pop(address, None)
+                if not conn.closed:
+                    spawn(conn.close(), name="drop-read-connection")
+                continue
+            error = getattr(response, "error", None)
+            if error in (msg.NOT_LEADER, msg.NO_LEADER, msg.INTERNAL):
+                # lag refusal ("state lagging behind client index") or a
+                # server that won't serve: take the leader-routed path
+                break
+            self.metrics.counter("client_reads_follower_lane").inc()
+            return response
+        self.metrics.counter("client_reads_leader_fallback").inc()
+        return await self._request(request, leader_required=False)
 
     # -- session protocol --------------------------------------------------
 
@@ -496,14 +547,21 @@ class RaftClient(Managed):
                                  items: list) -> None:
         leader_required = consistency in ("linearizable",
                                           "bounded_linearizable")
+        # every read is tagged with its consistency (the request field);
+        # sub-linearizable levels route round-robin across replicas
+        round_robin = (not leader_required and self._follower_reads
+                       and len(self.members) > 1)
         if len(items) == 1:
             operation, fut = items[0]
+            request = msg.QueryRequest(
+                session_id=self._session.id, index=self._index,
+                operation=operation, consistency=consistency)
             try:
-                response = await self._request(
-                    msg.QueryRequest(session_id=self._session.id,
-                                     index=self._index, operation=operation,
-                                     consistency=consistency),
-                    leader_required=leader_required)
+                if round_robin:
+                    response = await self._request_read(request)
+                else:
+                    response = await self._request(
+                        request, leader_required=leader_required)
                 result = self._finish(response, None)
             except BaseException as e:  # noqa: BLE001 — delivered via fut
                 if not fut.done():
@@ -513,12 +571,15 @@ class RaftClient(Managed):
                 fut.set_result(result)
             return
         try:
-            response = await self._request(
-                msg.QueryBatchRequest(
-                    session_id=self._session.id, index=self._index,
-                    consistency=consistency,
-                    operations=[op for op, _ in items]),
-                leader_required=leader_required)
+            request = msg.QueryBatchRequest(
+                session_id=self._session.id, index=self._index,
+                consistency=consistency,
+                operations=[op for op, _ in items])
+            if round_robin:
+                response = await self._request_read(request)
+            else:
+                response = await self._request(
+                    request, leader_required=leader_required)
             if getattr(response, "error", None):
                 self._finish(response, None)  # raises the right exception
         except BaseException as e:  # noqa: BLE001

@@ -586,6 +586,17 @@ class DeviceEngine:
             f"vector pump: {missing}/{len(tag_l)} rows uncommitted after "
             f"{max_rounds} rounds")
 
+    def run_query_vector(self, groups_idx, opcodes, a, b, c) -> list[int]:
+        """The batched READ pump's device leg: evaluate every row through
+        ONE :func:`~copycat_tpu.ops.consensus.query_step` engine round
+        (``RaftGroups.drive_query_vector``) instead of a blocking
+        ``serve_query`` device round-trip per read. No log append, no
+        state change — serving is leader-applied-state only, exactly the
+        per-op :meth:`query` lane's semantics."""
+        groups = self._ensure()
+        return groups.drive_query_vector(
+            groups_idx, opcodes, a, b, c).tolist()
+
 
 class _Held:
     """Retained commit + optional host-side value + TTL timer.
@@ -616,6 +627,11 @@ class _Held:
 # Vector-op finalize kinds (vector_spec's last element): how the host
 # bookkeeping consumes the device result at the batched pump's finalize.
 VK_CAS, VK_GET_AND_SET, VK_SET = 1, 2, 3
+
+# Query-spec finalize kinds (query_spec's last element). Reads never
+# mutate host bookkeeping, so the only consumption modes are the raw
+# device int and its truthiness.
+QK_RAW, QK_BOOL = 1, 2
 
 
 class DeviceBackedStateMachine(ResourceStateMachine):
@@ -701,6 +717,27 @@ class DeviceBackedStateMachine(ResourceStateMachine):
     def vector_finalize(self, kind: int, operation: Any, raw: int,
                         commit: Commit) -> Any:
         raise NotImplementedError  # pragma: no cover — spec implies finalize
+
+    # -- batched read pump (query vector lane) -----------------------------
+    #
+    # The read-side analog of vector_spec/vector_finalize: a machine
+    # whose query handler is exactly ONE device query (no host shadow, no
+    # host-only answer) opts its reads into the applying server's read
+    # window, which evaluates the whole window through one query_step
+    # engine round. The pair must return exactly what the plain query
+    # handler returns — tests/test_spi_read_pump.py proves it
+    # differentially against the per-op lane.
+
+    def query_spec(self, operation: Any
+                   ) -> tuple[int, int, int, int, int] | None:
+        """(opcode, a, b, c, finalize_kind) for a read servable as ONE
+        device query, or ``None`` when the read needs its handler (host
+        shadow values, host-derived answers, mixed host/device state)."""
+        return None
+
+    def query_finalize(self, kind: int, operation: Any, raw: int) -> Any:
+        """Shape the raw device int like the plain handler's return."""
+        return bool(raw) if kind == QK_BOOL else raw
 
     def delete(self) -> None:
         self._eng.release(self._group)
@@ -882,6 +919,18 @@ class DeviceAtomicValueState(DeviceBackedStateMachine):
         self._held = _Held(commit, on_device=True)
         return raw if kind == VK_GET_AND_SET else None
 
+    # -- read pump (query vector lane) -------------------------------------
+    # A get is one device query exactly when the value is held ON DEVICE
+    # (host-shadowed and unset values answer from host state); listeners
+    # and TTL timers don't gate reads — get never touches them.
+
+    def query_spec(self, operation: Any
+                   ) -> tuple[int, int, int, int, int] | None:
+        if (type(operation) is vc.Get and self._held is not None
+                and self._held.on_device):
+            return (ops().OP_VALUE_GET, 0, 0, 0, QK_RAW)
+        return None
+
     # -- change listeners (same protocol as the CPU machine) ---------------
     # listen/unlisten are host-state-only but still run as ordered jobs
     # (``yield from ()``): a later listen must not observe state ahead of
@@ -1044,6 +1093,20 @@ class DeviceMapState(DeviceBackedStateMachine):
             return len(self._held)
         finally:
             commit.close()
+
+    # -- read pump (query vector lane) -------------------------------------
+    # A keyed read is one device query exactly when the key's value is
+    # held ON DEVICE; absent keys and host-shadowed values answer from
+    # host state and keep the handler path.
+
+    def query_spec(self, operation: Any
+                   ) -> tuple[int, int, int, int, int] | None:
+        t = type(operation)
+        if t is cc.MapGet or t is cc.MapGetOrDefault:
+            held = self._held.get(operation.key)
+            if held is not None and held.on_device:
+                return (ops().OP_MAP_GET, operation.key, 0, 0, QK_RAW)
+        return None
 
     # -- commands ----------------------------------------------------------
 

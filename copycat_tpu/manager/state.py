@@ -21,7 +21,7 @@ from typing import Any, Callable
 from ..server.session import ServerSession, SessionState
 from ..server.state_machine import Commit, StateMachine, StateMachineExecutor
 from ..utils.metrics import MetricsRegistry
-from ..resource.operations import ResourceCommand
+from ..resource.operations import ResourceCommand, ResourceQuery
 from ..resource.state_machine import ResourceStateMachine, ResourceStateMachineExecutor
 from .operations import (
     CreateResource,
@@ -276,6 +276,35 @@ class ResourceManager(StateMachine):
             return None
         machine = instance.resource.state_machine
         spec_fn = getattr(machine, "vector_spec", None)
+        if spec_fn is None:
+            return None
+        inner = envelope.operation
+        spec = spec_fn(inner)
+        if spec is None:
+            return None
+        return machine, instance, inner, spec
+
+    # -- batched read pump (query vector lane) -----------------------------
+
+    def query_route(self, operation: Any):
+        """Classify one READ for the applying server's read window:
+        ``(machine, instance, inner_op, spec)`` when the op is a routed
+        resource query whose device-backed machine can serve it as ONE
+        device query (``DeviceBackedStateMachine.query_spec``), else
+        ``None`` — the per-op query lane handles everything else
+        (catalog queries, host-shadowed state, CPU machines). Exact-type
+        checks keep subclasses on the general path, like
+        :meth:`vector_route`."""
+        if type(operation) is not InstanceQuery:
+            return None
+        envelope = operation.operation
+        if type(envelope) is not ResourceQuery:
+            return None
+        instance = self.instances.get(operation.resource)
+        if instance is None:
+            return None
+        machine = instance.resource.state_machine
+        spec_fn = getattr(machine, "query_spec", None)
         if spec_fn is None:
             return None
         inner = envelope.operation

@@ -72,6 +72,24 @@ def _fused_rounds_program(config: Config, n: int):
     return jax.jit(fused)
 
 
+def _group_slot_pack(g: np.ndarray
+                     ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Stable per-group slot assignment for ``[N]`` rows: returns
+    ``(order, gs, slots)`` such that rows taken in ``order`` land at
+    ``(gs[i], slots[i])`` of a ``[G, S]`` buffer, with row order within
+    a group preserved (the per-group FIFO witness both the vector
+    submit lane and the vector read lane rely on)."""
+    order = np.argsort(g, kind="stable")
+    gs = g[order]
+    n = gs.size
+    first = np.ones(n, bool)
+    first[1:] = gs[1:] != gs[:-1]
+    starts = np.flatnonzero(first)
+    cnt = np.diff(np.append(starts, n))
+    slots = np.arange(n) - np.repeat(starts, cnt)
+    return order, gs, slots
+
+
 class RaftGroups:
     """G Raft groups × P peers, stepped as one compiled program."""
 
@@ -376,14 +394,7 @@ class RaftGroups:
         counts = np.bincount(g, minlength=self.num_groups)
         if counts.max(initial=0) > self.submit_slots:
             return False
-        order = np.argsort(g, kind="stable")
-        gs = g[order]
-        n = gs.size
-        first = np.ones(n, bool)
-        first[1:] = gs[1:] != gs[:-1]
-        starts = np.flatnonzero(first)
-        cnt = np.diff(np.append(starts, n))
-        slots = np.arange(n) - np.repeat(starts, cnt)
+        order, gs, slots = _group_slot_pack(g)
         sub = self._empty_submits()
         sub.opcode[gs, slots] = op[order]
         sub.a[gs, slots] = a[order]
@@ -651,6 +662,79 @@ class RaftGroups:
                 self._queues.setdefault(g, deque()).append((*op, tag))
                 self._inflight_ops[tag] = op  # joins the loss-retry protocol
                 fell_back.inc()
+
+    def drive_query_vector(self, groups, opcode, a=0, b=0, c=0,
+                           atomic=False,
+                           max_attempts: int = 50) -> np.ndarray:
+        """One-shot vectorized READ serve: stage ``[N]`` read rows into
+        per-group slots of ONE :func:`query_step` evaluation (no log
+        append, no correlation tags, no per-op dicts) and return results
+        aligned with the input rows. The read analog of
+        :meth:`drive_vector` — the applying server's batched read pump
+        stages a whole window here instead of paying a full device
+        round-trip per ``serve_query`` call.
+
+        ``atomic`` (scalar or ``[N]``) marks rows needing the leader
+        LEASE (BOUNDED_LINEARIZABLE freshness); the SPI read pump passes
+        False — its host-side gate already established the linearization
+        point, exactly like the per-op ``DeviceEngine.query`` lane.
+
+        Unserved rows (group mid-election, applied < commit) retry after
+        a settling :meth:`step_round`, like :meth:`serve_query`; in the
+        warm steady state every row serves on the first evaluation. The
+        slot width pads to the next power of two so burst-size jitter
+        compiles at most log2 variants of the query program."""
+        from ..ops.apply import QUERY_OPCODES
+        g = np.asarray(groups, np.int64).ravel()
+        n = g.size
+        out = np.zeros(n, np.int64)
+        if n == 0:
+            return out
+        bc = lambda x: np.broadcast_to(
+            np.asarray(x, np.int32).ravel(), (n,))
+        op_a, a_a, b_a, c_a = bc(opcode), bc(a), bc(b), bc(c)
+        bad = ~np.isin(op_a, tuple(QUERY_OPCODES))
+        if bad.any():
+            raise ValueError(
+                f"opcode {int(op_a[bad][0])} is not read-only; submit it "
+                "as a command")
+        at_a = np.broadcast_to(np.asarray(atomic, bool).ravel(), (n,))
+        counts = np.bincount(g, minlength=self.num_groups)
+        width = int(counts.max(initial=1))
+        S = 1 << (width - 1).bit_length()  # pow2: bounded jit variants
+        G = self.num_groups
+        order, gs, slots = _group_slot_pack(g)
+        sub = Submits(opcode=np.zeros((G, S), np.int32),
+                      a=np.zeros((G, S), np.int32),
+                      b=np.zeros((G, S), np.int32),
+                      c=np.zeros((G, S), np.int32),
+                      tag=np.zeros((G, S), np.int32),
+                      valid=np.zeros((G, S), bool))
+        sub.opcode[gs, slots] = op_a[order]
+        sub.a[gs, slots] = a_a[order]
+        sub.b[gs, slots] = b_a[order]
+        sub.c[gs, slots] = c_a[order]
+        sub.valid[gs, slots] = True
+        at = np.zeros((G, S), bool)
+        at[gs, slots] = at_a[order]
+        done = np.zeros(n, bool)
+        served_ctr = self.metrics.counter("queries_served")
+        for _ in range(max_attempts):
+            results, served = self._run_query(sub, at)
+            hit = served[gs, slots] & ~done[order]
+            if hit.any():
+                rows = order[hit]
+                out[rows] = results[gs[hit], slots[hit]]
+                done[rows] = True
+                served_ctr.inc(int(hit.sum()))
+                sub.valid[gs[hit], slots[hit]] = False
+            if self._agree(bool(done.all())):
+                self.metrics.counter("query_vector_drives").inc()
+                return out
+            self.step_round()  # no leader yet / applied < commit: settle
+        raise TimeoutError(
+            f"query vector: {int((~done).sum())}/{n} rows unservable "
+            f"after {max_attempts} attempts")
 
     def _record_assigned(self, submits: Submits, out: StepOutputs) -> None:
         """Remember the (log index, term) each accepted queue-managed op

@@ -83,6 +83,20 @@ class SessionEvent(NamedTuple):
 _INDETERMINATE = object()
 _EXPIRED = object()
 
+#: SPI read-consistency vocabulary -> device query lane level. The
+#: device lane has two serving regimes (leader applied state; leader
+#: applied state + lease gate); each SPI level maps to the weakest
+#: regime that satisfies it.
+_READ_LEVELS = {
+    "none": "sequential",
+    "causal": "sequential",
+    "process": "sequential",
+    "sequential": "sequential",
+    "atomic": "atomic",
+    "bounded_linearizable": "atomic",
+    "linearizable": "atomic",
+}
+
 
 class _Chunk(NamedTuple):
     """One buffered batch of commands (vectorized submission unit)."""
@@ -184,14 +198,30 @@ class BulkSession:
 
     def query_batch(self, groups, opcode, a=0, b=0, c=0,
                     consistency: str = "sequential") -> np.ndarray:
-        """Serve reads through the query lane (no log entry), at the
-        requested consistency (``"atomic"`` = leader-lease linearizable
-        — reference ``Consistency.java:157-176``). Counts as session
-        activity (keep-alive)."""
+        """Serve reads through the query lane (no log entry), tagged
+        with their ``consistency`` and routed by it — the full SPI read
+        vocabulary is accepted so both planes speak one language:
+        ``causal``/``sequential`` serve from the leader lane's applied
+        state (the reference's sub-ATOMIC routing), while
+        ``bounded_linearizable``/``linearizable``/``atomic`` gate each
+        slot on the leader LEASE (``RaftState.lease``) — in the
+        synchronous round model the lease round IS the linearization
+        point (no other leader can have committed), so lease-gated reads
+        serve both levels without a log append (reference
+        ``Consistency.java:157-176``). Counts as session activity
+        (keep-alive)."""
+        level = _READ_LEVELS.get(consistency)
+        if level is None:
+            raise ValueError(
+                f"unknown read consistency {consistency!r}; pick one of "
+                f"{sorted(_READ_LEVELS)}")
         self._check_open()
+        g = np.asarray(groups, np.int64).ravel()
+        self._client._rg.metrics.counter(
+            "session_reads", consistency=consistency).inc(int(g.size))
         self._client._registry.keep_alive(self.id)
         return self._client._driver.drive_queries(
-            groups, opcode, a, b, c, consistency=consistency)
+            g, opcode, a, b, c, consistency=level)
 
     # -- events ------------------------------------------------------------
 

@@ -290,6 +290,35 @@ class Config(NamedTuple):
     telemetry: bool = False
 
 
+def pin_partitionable_rng() -> None:
+    """Pin ``jax_threefry_partitionable`` ON before the step's RNG is
+    traced. The legacy lowering materializes GLOBAL random bits and
+    slices each shard's block, which on a group-sharded mesh compiles to
+    collective-permutes + all-reduces per ``random.randint`` — the
+    election-timer draws alone put 22 all-reduces into the step and
+    broke the zero-collective contract (MULTICHIP_SCALING.md) on jax
+    builds that default the flag off; the partitionable form derives
+    every shard's bits locally from the key.
+
+    Invoked at THIS module's import (below), before any repo path can
+    touch ``jax.random``: the flag changes ``PRNGKey``/``split`` values
+    too, so a lazier pin (e.g. inside ``init_state`` alone) would make
+    two same-seed engines built sequentially in one process diverge —
+    the first one's key splits run pre-flag, the second's post-flag —
+    and break every same-seed differential. The scope is already
+    confined: neither the package root nor the client imports ``ops``,
+    so host applications that merely import the client never see the
+    flag; only engine users (who need it for the zero-collective
+    contract) do. Random STREAMS differ from unflagged runs (timer
+    draws change), but all in-repo determinism is
+    same-process/same-flag — multihost lockstep holds because every
+    process imports this module."""
+    jax.config.update("jax_threefry_partitionable", True)
+
+
+pin_partitionable_rng()
+
+
 def init_state(num_groups: int, num_peers: int, log_slots: int,
                key: jax.Array, config: Config = Config(),
                members=None) -> RaftState:

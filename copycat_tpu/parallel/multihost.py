@@ -60,6 +60,18 @@ def initialize(coordinator_address: str, num_processes: int,
     coordinator (process 0's address)."""
     if platform:
         jax.config.update("jax_platforms", platform)
+    # CPU multiprocess needs an explicit collectives backend: jaxlib
+    # builds that default jax_cpu_collectives_implementation to "none"
+    # refuse every cross-process program outright ("Multiprocess
+    # computations aren't implemented on the CPU backend" — the round-9
+    # tier-1 drift). Gloo ships in jaxlib; selecting it restores the
+    # CPU-mesh lockstep tests and is inert for TPU meshes (the knob only
+    # picks the CPU backend's collectives transport). Older jax without
+    # the knob already wires CPU collectives — skip quietly there.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 — knob absent: nothing to select
+        pass
     jax.distributed.initialize(coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

@@ -86,6 +86,37 @@ def _collective_census(n_devices: int, devices) -> dict:
         fn.lower(state, submits, deliver, key).compile().as_text())
 
 
+def _query_census(n_devices: int, devices) -> dict:
+    """Census the READ plane: the ``query_step`` program (round-9 batched
+    read pump's device leg) compiled over the sharded mesh. Reads are
+    leader-lane selects + one fused apply pass per group — group-local by
+    construction — so the correct compilation target is the same ZERO
+    cross-device collectives the step holds."""
+    from functools import partial
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ..ops.consensus import (
+        Config, init_state, make_submits, query_step)
+    from ..parallel.mesh import shard_state, shard_step_inputs
+
+    mesh = Mesh(np.asarray(devices[:n_devices]), ("groups",))
+    config = Config()
+    key = jax.random.PRNGKey(0)
+    key, init_key = jax.random.split(key)
+    state = shard_state(
+        init_state(CENSUS_GROUPS, PEERS, 32, init_key, config), mesh)
+    queries = make_submits(CENSUS_GROUPS, 4)
+    queries, _ = shard_step_inputs(
+        queries, jnp.ones((CENSUS_GROUPS, PEERS, PEERS), bool), mesh)
+    atomic = jax.device_put(jnp.zeros((CENSUS_GROUPS, 4), bool),
+                            NamedSharding(mesh, P("groups", None)))
+    fn = jax.jit(partial(query_step, config=config))
+    return _census_text(
+        fn.lower(state, queries, atomic).compile().as_text())
+
+
 def _measure_bulk(n_devices: int, devices) -> dict:
     """Client-visible deep-drive throughput on the sharded mesh (round-4
     addition): the FULL bulk plane — blind pipelined dispatch, on-device
@@ -242,6 +273,7 @@ def _measure(n_devices: int, devices) -> dict:
     submits, deliver = shard_step_inputs(submits, deliver, mesh)
     fn = jax.jit(partial(step, config=config))
     collectives = _collective_census(n_devices, devices)
+    query_collectives = _query_census(n_devices, devices)
 
     t0 = time.perf_counter()
     for _ in range(3):  # warm-up (includes compile)
@@ -259,7 +291,8 @@ def _measure(n_devices: int, devices) -> dict:
     return {"devices": n_devices,
             "ms_per_round": round(dt / ROUNDS * 1e3, 2),
             "warmup_s": round(compile_s, 1),
-            "collectives": collectives}
+            "collectives": collectives,
+            "query_collectives": query_collectives}
 
 
 def main() -> None:
@@ -274,12 +307,14 @@ def main() -> None:
     for row in rows:
         row["vs_1dev"] = round(row["ms_per_round"] / base, 2)
     no_collectives = all(not row["collectives"] for row in rows)
+    query_no_coll = all(not row["query_collectives"] for row in rows)
     bulk_rows = [_measure_bulk(n, devices) for n in (1, 2, 4, 8)]
     bulk_no_coll = all(not row["collectives"] for row in bulk_rows)
     scan_no_coll = all(not row["scan_collectives"] for row in bulk_rows)
     result = {"groups": GROUPS, "peers": PEERS, "rounds": ROUNDS,
               "mesh_axis": "groups", "host_cores": host_cores,
               "no_cross_device_collectives": no_collectives,
+              "query_no_cross_device_collectives": query_no_coll,
               "bulk_no_cross_device_collectives": bulk_no_coll,
               "deep_scan_no_cross_device_collectives": scan_no_coll,
               "table": rows, "bulk_table": bulk_rows}
@@ -304,6 +339,9 @@ def main() -> None:
         "",
         f"- cross-device collectives at 1/2/4/8 devices: "
         + ("**none** ✓" if no_collectives else "**FOUND** ✗ (see JSON)"),
+        f"- query_step (round-9 read plane) cross-device collectives at "
+        f"1/2/4/8 devices: "
+        + ("**none** ✓" if query_no_coll else "**FOUND** ✗ (see JSON)"),
         f"- host cores available to this process: **{host_cores}**",
         "",
         "Walltime on the virtual mesh is diagnostic only: virtual CPU",

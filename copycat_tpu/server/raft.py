@@ -188,6 +188,20 @@ class RaftServer(Managed):
         self._vector_pump = os.environ.get(
             "COPYCAT_SERVER_VECTOR_PUMP", "1") != "0"
 
+        # Batched read pump (the read-plane analog of the vector pump):
+        # device-eligible reads arriving across sessions and
+        # QueryBatchRequests coalesce into per-consistency read windows;
+        # each window pays its consistency gate ONCE (one
+        # leadership-confirm round shared by every linearizable read)
+        # and evaluates device-eligible reads as tensors through one
+        # query_step engine round. Default on; COPYCAT_SERVER_READ_PUMP=0
+        # keeps the per-op lane bit-identically (the readmix A/B knob,
+        # BENCH_SCENARIOS.md).
+        self._read_pump = os.environ.get(
+            "COPYCAT_SERVER_READ_PUMP", "1") != "0"
+        self._read_windows: dict[str, list] = {}  # consistency -> items
+        self._read_flush_scheduled = False
+
         # Observability plane (docs/OBSERVABILITY.md): counters and
         # histograms feed inline on the hot paths (a bare int add);
         # point-in-time gauges (term/role/lag/sessions) are refreshed
@@ -209,6 +223,18 @@ class RaftServer(Managed):
         self._m_vector_runs = m.counter("vector_runs")
         self._m_vector_ops = m.counter("vector_ops")
         self._m_run_length = m.histogram("apply_run_length")
+        # read-lane family (docs/OBSERVABILITY.md): window counters move
+        # only when the read pump is on; the per-consistency read mix
+        # counts on both lanes so the A/B stays attributable
+        self._m_query_windows = m.counter("query_windows")
+        self._m_query_ops = m.counter("query_ops")
+        self._m_query_window_ops = m.histogram("query_window_ops")
+        self._m_query_gate_saved = m.counter("query_gate_rounds_saved")
+        self._m_query_device = m.counter("query_ops_device_lane")
+        self._m_query_per_op = m.counter("query_ops_per_op_lane")
+        self._m_query_level = {
+            c.value: m.counter("query_reads", consistency=c.value)
+            for c in QueryConsistency}
 
         self._load_meta()
 
@@ -236,6 +262,11 @@ class RaftServer(Managed):
             if not fut.done():
                 fut.set_exception(msg.ProtocolError(msg.NO_LEADER, "server closed"))
         self._commit_futures.clear()
+        for items in self._read_windows.values():
+            for _, _, _, fut in items:
+                if not fut.done():
+                    fut.set_result((0, None, msg.NO_LEADER, "server closed"))
+        self._read_windows.clear()
         await self._server.close()
         await self._client.close()
         self._peer_connections.clear()
@@ -1095,6 +1126,28 @@ class RaftServer(Managed):
 
     async def _on_query(self, request: msg.QueryRequest) -> msg.QueryResponse:
         consistency = QueryConsistency(request.consistency or "linearizable")
+        self._m_query_level[consistency.value].inc()
+        if not self._read_pump:
+            return await self._query_direct(request, consistency)
+        self._m_query_ops.inc()
+        fut = self._stage_read(consistency, request.session_id,
+                               request.index or 0, request.operation)
+        index, result, code, detail = await fut
+        if code in (msg.NOT_LEADER, msg.NO_LEADER):
+            return self._not_leader(msg.QueryResponse)
+        if code == msg.APPLICATION:
+            return msg.QueryResponse(error=msg.APPLICATION,
+                                     error_detail=detail, index=index)
+        if code:
+            return msg.QueryResponse(error=code, error_detail=detail)
+        return msg.QueryResponse(index=index, result=result)
+
+    async def _query_direct(self, request: msg.QueryRequest,
+                            consistency: QueryConsistency
+                            ) -> msg.QueryResponse:
+        """The per-op read lane (COPYCAT_SERVER_READ_PUMP=0): gate and
+        execute this request alone — the pre-pump server bit-identically,
+        the readmix A/B baseline."""
         refused = await self._gate_query(consistency, request.index or 0)
         if refused is not None:
             code, detail = refused
@@ -1117,8 +1170,42 @@ class RaftServer(Managed):
                               ) -> msg.QueryBatchResponse:
         """Batched reads of one consistency level: the gate (leadership
         confirmation / applied wait) runs ONCE for the whole batch — a
-        quorum round amortized over N linearizable reads."""
+        quorum round amortized over N linearizable reads. With the read
+        pump on, the batch joins the server-wide per-consistency read
+        window, sharing that one gate round with every other session's
+        same-turn reads and the device-eligible subset of the window's
+        tensor evaluation."""
         consistency = QueryConsistency(request.consistency or "linearizable")
+        operations = request.operations or []
+        self._m_query_level[consistency.value].inc(len(operations))
+        if not self._read_pump or not operations:
+            return await self._query_batch_direct(request, consistency)
+        self._m_query_ops.inc(len(operations))
+        idx = request.index or 0
+        futs = [self._stage_read(consistency, request.session_id, idx, op)
+                for op in operations]
+        outs = await asyncio.gather(*futs)
+        entries = []
+        index = 0
+        for served_index, result, code, detail in outs:
+            if code in (msg.NOT_LEADER, msg.NO_LEADER):
+                return self._not_leader(msg.QueryBatchResponse)
+            if code and code != msg.APPLICATION:
+                # gate refusal: identical for every entry of this request
+                # (they share index + consistency) — response-level, like
+                # the per-op lane
+                return msg.QueryBatchResponse(error=code, error_detail=detail)
+            if code:
+                entries.append((None, code, detail))
+            else:
+                entries.append((result, None, None))
+            index = max(index, served_index)
+        return msg.QueryBatchResponse(index=index, entries=entries)
+
+    async def _query_batch_direct(self, request: msg.QueryBatchRequest,
+                                  consistency: QueryConsistency
+                                  ) -> msg.QueryBatchResponse:
+        """Per-op lane for one batch request (pump off / empty batch)."""
         refused = await self._gate_query(consistency, request.index or 0)
         if refused is not None:
             code, detail = refused
@@ -1138,6 +1225,167 @@ class RaftServer(Managed):
                 commit.close()
         return msg.QueryBatchResponse(index=self.last_applied,
                                       entries=entries)
+
+    # -- batched read pump (the read window) ---------------------------
+
+    def _stage_read(self, consistency: QueryConsistency, session_id: int,
+                    client_index: int, operation: Any) -> asyncio.Future:
+        """Stage one read into the current per-consistency read window;
+        resolves to ``(index, result, error_code, error_detail)``. The
+        window flushes at the end of the event-loop turn (the same
+        call_soon coalescing the client micro-batch uses), so reads
+        arriving across sessions and requests in one turn share a gate."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._read_windows.setdefault(consistency.value, []).append(
+            (session_id, client_index, operation, fut))
+        if not self._read_flush_scheduled:
+            self._read_flush_scheduled = True
+            loop.call_soon(self._launch_read_windows)
+        return fut
+
+    def _launch_read_windows(self) -> None:
+        self._read_flush_scheduled = False
+        windows, self._read_windows = self._read_windows, {}
+        for level, items in windows.items():
+            if items:
+                spawn(self._flush_read_window(QueryConsistency(level), items),
+                      name="read-window")
+
+    @staticmethod
+    def _resolve_read(fut: asyncio.Future, payload: tuple) -> None:
+        if not fut.done():
+            fut.set_result(payload)
+
+    async def _flush_read_window(self, consistency: QueryConsistency,
+                                 items: list) -> None:
+        try:
+            await self._run_read_window(consistency, items)
+        except Exception as e:  # noqa: BLE001 — no staged read may hang
+            logger.exception("read window failed")
+            for _, _, _, fut in items:
+                self._resolve_read(fut, (0, None, msg.INTERNAL, str(e)))
+
+    async def _run_read_window(self, consistency: QueryConsistency,
+                               items: list) -> None:
+        """Serve one read window: the consistency gate ONCE, then the
+        reads at an applied snapshot — device-eligible reads as tensors
+        through one query_step engine round, the rest through the per-op
+        executor lane bit-identically."""
+        n = len(items)
+        self._m_query_windows.inc()
+        self._m_query_window_ops.record(n)
+        if consistency in (QueryConsistency.LINEARIZABLE,
+                           QueryConsistency.BOUNDED_LINEARIZABLE):
+            if self.role != LEADER:
+                for _, _, _, fut in items:
+                    self._resolve_read(fut, (0, None, msg.NOT_LEADER, ""))
+                return
+            linear = consistency is QueryConsistency.LINEARIZABLE
+            if linear or not self._lease_valid():
+                ok = await self._confirm_leadership()
+            else:
+                ok = True
+            if not ok:
+                for _, _, _, fut in items:
+                    self._resolve_read(fut, (0, None, msg.NOT_LEADER, ""))
+                return
+            if linear:
+                # ONE leadership-confirm round served the whole window;
+                # the per-op lane pays one per LINEARIZABLE read — the
+                # N-1 amortized rounds are the counter the differential
+                # test asserts. Bounded windows never count here: the
+                # per-op lane's first confirm renews the lease
+                # (_last_quorum_contact), so its reads 2..N are
+                # confirm-free too — nothing is actually saved. A failed
+                # confirm (refused window) amortizes nothing either.
+                self._m_query_gate_saved.inc(n - 1)
+            await self._wait_applied(self.commit_index)
+            # the gate established the linearization point: serve at it
+            # regardless of the client's (necessarily older) index
+            self._evaluate_reads(items, check_index=False)
+            return
+        # SEQUENTIAL / CAUSAL: a read whose own index is already applied
+        # serves NOW (the per-op lane's latency — no head-of-line wait
+        # behind an unrelated session's lagging index); stragglers share
+        # one wait on their max index and refuse per-op at timeout.
+        applied = self.last_applied
+        ready = [it for it in items if not it[1] or it[1] <= applied]
+        lagging = [it for it in items if it[1] and it[1] > applied]
+        if ready:
+            self._evaluate_reads(ready, check_index=True)
+        if lagging:
+            await self._wait_applied(max(it[1] for it in lagging),
+                                     timeout=self.election_timeout * 4)
+            self._evaluate_reads(lagging, check_index=True)
+
+    def _evaluate_reads(self, items: list, check_index: bool) -> None:
+        """Serve one batch of gated reads at the current applied
+        snapshot. ``check_index`` refuses reads still lagging the
+        client's index (a timed-out applied wait) exactly like the
+        per-op lane's gate."""
+        applied = self.last_applied
+        clock = self.context.clock
+        route = getattr(self.state_machine, "query_route", None)
+        rows: list = []  # (future, machine, instance, inner, spec)
+        for session_id, client_index, operation, fut in items:
+            if check_index and client_index and client_index > applied:
+                self._resolve_read(
+                    fut, (0, None, msg.INTERNAL,
+                          "state lagging behind client index"))
+                continue
+            rec = route(operation) if route is not None else None
+            if rec is not None:
+                rows.append((fut, *rec))
+                continue
+            self._m_query_per_op.inc()
+            session = self.sessions.get(session_id)
+            commit = Commit(applied, session, clock, operation, None)
+            try:
+                result = self.executor.execute(commit)
+            except Exception as e:  # noqa: BLE001 — app errors cross
+                self._resolve_read(
+                    fut, (applied, None, msg.APPLICATION, str(e)))
+            else:
+                self._resolve_read(fut, (applied, result, None, None))
+            finally:
+                commit.close()
+        if rows:
+            self._serve_query_rows(rows, applied)
+
+    def _serve_query_rows(self, rows: list, applied: int) -> None:
+        """One query_step engine round for every device-eligible read in
+        the window (the read analog of ``_apply_vector_run``): stage [N]
+        rows, evaluate from the leader lane's applied state, correlate
+        results in a single pass — no per-op Commit objects, no per-op
+        executor dispatch."""
+        m = len(rows)
+        self._m_query_device.inc(m)
+        engine = self.state_machine.device_engine
+        groups = [0] * m
+        opc = [0] * m
+        av = [0] * m
+        bv = [0] * m
+        cv = [0] * m
+        for i, (_fut, machine, _inst, _op, spec) in enumerate(rows):
+            groups[i] = machine._group
+            opc[i], av[i], bv[i], cv[i] = spec[0], spec[1], spec[2], spec[3]
+        try:
+            raws = engine.run_query_vector(groups, opc, av, bv, cv)
+        except Exception as e:  # noqa: BLE001 — fail loudly, never hang
+            logger.exception("query vector failed; failing %d reads", m)
+            for fut, *_rest in rows:
+                self._resolve_read(
+                    fut, (applied, None, msg.APPLICATION, str(e)))
+            return
+        for i, (fut, machine, _inst, inner, spec) in enumerate(rows):
+            try:
+                result = machine.query_finalize(spec[4], inner, raws[i])
+            except Exception as e:  # noqa: BLE001 — app errors cross
+                self._resolve_read(
+                    fut, (applied, None, msg.APPLICATION, str(e)))
+            else:
+                self._resolve_read(fut, (applied, result, None, None))
 
     async def _wait_applied(self, index: int, timeout: float | None = None) -> bool:
         deadline = (time.monotonic() + timeout) if timeout else None

@@ -68,6 +68,18 @@ def test_deep_scan_census_zero_collectives_on_mesh():
     assert _deep_scan_census(8, devices, config) == {}
 
 
+def test_query_step_census_zero_collectives_on_mesh():
+    """The round-9 read plane: the ``query_step`` program (the batched
+    read pump's device leg) is leader-lane selects + one fused apply
+    pass per group — group-local by construction — and must compile to
+    zero cross-device collectives like the step."""
+    from copycat_tpu.parallel.scaling import _query_census
+
+    devices = jax.devices("cpu")
+    assert _query_census(2, devices) == {}
+    assert _query_census(8, devices) == {}
+
+
 def test_census_positive_control():
     """The census must be able to SEE collectives — a broken tally that
     always returns {} would turn the scaling artifact into a false

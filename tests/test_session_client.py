@@ -78,6 +78,14 @@ def test_queries_and_atomic_reads(client):
     client.flush()
     vals = s.query_batch([2] * 4, ap.OP_VALUE_GET, consistency="atomic")
     assert list(vals) == [21] * 4
+    # the full SPI read vocabulary routes (round 9): sub-linearizable
+    # levels serve from applied state, linearizable rides the lease gate
+    for level in ("none", "causal", "process", "sequential",
+                  "bounded_linearizable", "linearizable"):
+        got = s.query_batch([2, 2], ap.OP_VALUE_GET, consistency=level)
+        assert list(got) == [21, 21], (level, got)
+    with pytest.raises(ValueError, match="unknown read consistency"):
+        s.query_batch([2], ap.OP_VALUE_GET, consistency="nope")
 
 
 def test_lock_events_and_expiry_fanout(deep_rg, client):

@@ -114,6 +114,58 @@ async def test_traces_route_shows_spans():
         await server.close()
 
 
+@async_test(timeout=120)
+async def test_read_lane_family_on_stats_and_metrics():
+    """Round-9 read-lane counters (query_windows / query_ops /
+    query_gate_rounds_saved / per-consistency reads) land in the raft
+    registry and render on both exposition surfaces."""
+    server, client = await _running_server()
+    try:
+        counter = await client.get("hits", DistributedAtomicLong)
+        await asyncio.gather(*(counter.get() for _ in range(6)))
+        port = server.stats.port
+        raft = json.loads(
+            await fetch_stats(f"127.0.0.1:{port}", "/stats"))["raft"]
+        assert raft["query_windows"] >= 1
+        assert raft["query_ops"] >= 6
+        assert raft["query_window_ops"]["count"] >= 1
+        assert "query_gate_rounds_saved" in raft
+        assert raft["query_reads{consistency=bounded_linearizable}"] >= 6
+        prom = (await fetch_stats(f"127.0.0.1:{port}", "/metrics")).decode()
+        assert "# TYPE copycat_query_windows counter" in prom
+        assert "copycat_query_reads" in prom
+    finally:
+        await client.close()
+        await server.close()
+
+
+def test_cli_stats_what_all(capsys):
+    """``copycat-tpu stats --what all`` renders every surface in one
+    shot — the JSON snapshot (read-lane family included), the
+    Prometheus text, and the flight ring."""
+    async def run():
+        server, client = await _running_server()
+        port = server.stats.port
+        try:
+            counter = await client.get("hits", DistributedAtomicLong)
+            await asyncio.gather(*(counter.get() for _ in range(4)))
+            rc = await asyncio.to_thread(
+                cli._stats, type("A", (), {"address": f"127.0.0.1:{port}",
+                                           "what": "all"})())
+            assert rc == 0
+        finally:
+            await client.close()
+            await server.close()
+
+    asyncio.run(asyncio.wait_for(run(), 110))
+    out = capsys.readouterr().out
+    assert "=== stats ===" in out
+    assert '"query_windows"' in out
+    assert "=== metrics ===" in out
+    assert "copycat_query_windows" in out
+    assert "=== flight ===" in out
+
+
 def test_cli_stats_verb(capsys):
     async def run():
         server, client = await _running_server()
