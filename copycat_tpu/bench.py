@@ -32,6 +32,13 @@ Scenarios (``COPYCAT_BENCH_SCENARIO``, BASELINE.md benchmark configs):
 - ``readmix``: read-dominated (90/10) traffic through the public API —
   the batched read pump's A/B scenario (``COPYCAT_SERVER_READ_PUMP``);
   headline value is client-visible reads/sec.
+- ``cluster``: the first REPLICATED-cluster scenario — a 3-member
+  ``RaftServer`` cluster over the local transport with a nemesis-injected
+  per-message latency (a realistic LAN RTT; without it an in-process
+  "network" hides exactly the stop-and-wait stall this scenario exists
+  to measure), writes through the public ``RaftClient`` API; headline
+  value is committed ops/sec. The pipelined replication plane's A/B
+  knob is ``COPYCAT_REPL_PIPELINE`` (docs/REPLICATION.md).
 """
 
 from __future__ import annotations
@@ -943,6 +950,163 @@ def run_readmix() -> dict:
     return asyncio.run(drive())
 
 
+def run_cluster() -> dict:
+    """The first replicated-cluster bench: committed ops/sec through a
+    REAL N-member ``RaftServer`` cluster (leader election, pipelined
+    AppendEntries streams, quorum commit) on the local transport, writes
+    through the public ``RaftClient`` API (micro-batched sessioned
+    commands, exactly-once seqs).
+
+    A fixed per-message-leg delay (``COPYCAT_BENCH_CLUSTER_DELAY_MS``,
+    default 2.0 ms — a realistic same-region cross-AZ RTT of ~4 ms) is
+    injected via the transport nemesis so the leader->follower
+    replication stream actually pays wire latency: stop-and-wait
+    replication (``COPYCAT_REPL_PIPELINE=0``) is then capped at
+    window/RTT entries/s per peer, which is exactly what the pipelined
+    plane exists to break. The A/B pair for PERF.md round 10 is this
+    scenario run twice, once per lane."""
+    import asyncio
+
+    from .client.client import RaftClient
+    from .io.local import LocalServerRegistry, LocalTransport
+    from .io.transport import Address
+    from .protocol.messages import Message
+    from .protocol.operations import Command, Query
+    from .io.serializer import serialize_with
+    from .server.raft import LEADER, RaftServer
+    from .server.state_machine import Commit, StateMachine
+
+    @serialize_with(940)
+    class ClusterAdd(Message, Command):
+        _fields = ("key", "delta")
+
+    @serialize_with(941)
+    class ClusterGet(Message, Query):
+        _fields = ("key",)
+
+    class CounterMachine(StateMachine):
+        def __init__(self) -> None:
+            super().__init__()
+            self.data: dict = {}
+
+        # explicit registration: the auto-register table resolves
+        # annotations in module scope, and these op types are locals
+        def configure(self, executor) -> None:
+            executor.register(ClusterAdd, self.add)
+            executor.register(ClusterGet, self.get)
+
+        def add(self, commit: "Commit") -> int:
+            op = commit.operation
+            value = self.data.get(op.key, 0) + op.delta
+            self.data[op.key] = value
+            return value
+
+        def get(self, commit: "Commit") -> int:
+            return self.data.get(commit.operation.key, 0)
+
+    members = int(os.environ.get("COPYCAT_BENCH_CLUSTER_MEMBERS", "3"))
+    n_clients = int(os.environ.get("COPYCAT_BENCH_CLUSTER_CLIENTS", "4"))
+    ops_per_client = int(os.environ.get("COPYCAT_BENCH_CLUSTER_OPS", "1500"))
+    bursts = int(os.environ.get("COPYCAT_BENCH_CLUSTER_BURSTS", "5"))
+    delay_ms = float(os.environ.get("COPYCAT_BENCH_CLUSTER_DELAY_MS", "2.0"))
+    pipelined = os.environ.get("COPYCAT_REPL_PIPELINE", "1") != "0"
+
+    async def drive() -> dict:
+        registry = LocalServerRegistry()
+        addrs = [Address("local", 17000 + i) for i in range(members)]
+        servers = [
+            RaftServer(addr, addrs,
+                       LocalTransport(registry, local_address=addr),
+                       CounterMachine(),
+                       election_timeout=0.5, heartbeat_interval=0.1,
+                       session_timeout=120.0)
+            for addr in addrs]
+        await asyncio.gather(*(s.open() for s in servers))
+        deadline = time.perf_counter() + 30
+        leader = None
+        while time.perf_counter() < deadline:
+            leader = next((s for s in servers if s.role == LEADER), None)
+            if leader is not None:
+                break
+            await asyncio.sleep(0.02)
+        assert leader is not None, "no leader elected"
+        clients = [RaftClient(addrs, LocalTransport(registry),
+                              session_timeout=120.0)
+                   for _ in range(n_clients)]
+        await asyncio.gather(*(c.open() for c in clients))
+        # inject wire latency only once the cluster + sessions are up:
+        # the measured path is the replicated write plane, not elections
+        nem = registry.attach_nemesis()
+        nem.set_delay(delay_ms / 1e3)
+        log(f"bench[cluster]: {members} members, {n_clients} clients x "
+            f"{ops_per_client} ops/burst, {delay_ms} ms/leg "
+            f"({'pipelined' if pipelined else 'stop-and-wait'} replication, "
+            f"window {leader._repl_window}, depth {leader._repl_depth})")
+        _bench_gc_tune()
+        burst_ops = n_clients * ops_per_client
+        try:
+            async def one(client: RaftClient, key: str) -> None:
+                futs = [client.submit_command_nowait(
+                    ClusterAdd(key=key, delta=1))
+                    for _ in range(ops_per_client)]
+                await asyncio.gather(*futs)
+
+            reps = []
+            for rep in range(bursts):
+                t0 = time.perf_counter()
+                await asyncio.gather(*(one(c, f"k{i}")
+                                       for i, c in enumerate(clients)))
+                dt = time.perf_counter() - t0
+                ops = burst_ops / dt
+                reps.append(ops)
+                log(f"bench[cluster]: rep {rep}: {burst_ops} committed ops "
+                    f"in {dt:.3f}s -> {ops:,.0f} ops/sec")
+            # exactly-once spot check THROUGH the public read API: every
+            # client's counter saw every increment exactly once
+            for i, c in enumerate(clients):
+                v = await c.submit(ClusterGet(key=f"k{i}"))
+                assert v == bursts * ops_per_client, (i, v)
+            # replicated-state spot check: a quorum actually holds the data
+            await asyncio.sleep(0.3)
+            converged = sum(
+                1 for s in servers
+                if s.state_machine.data.get("k0") == bursts * ops_per_client)
+            assert converged >= len(servers) // 2 + 1, converged
+            METRICS_SNAPSHOTS["server"] = leader.stats_snapshot()
+            METRICS_SNAPSHOTS["client"] = clients[0].metrics.snapshot()
+            best = max(reps)
+            ack = leader.metrics.histogram("repl.ack_ms")
+            return {
+                "metric": (f"cluster_committed_ops_per_sec_{members}_members"
+                           + ("" if pipelined else "_stop_and_wait")),
+                "value": round(best, 1),
+                "unit": "ops/sec",
+                "vs_baseline": round(best / NORTH_STAR_OPS, 4),
+                "repl_pipeline": pipelined,
+                "repl_window": leader._repl_window,
+                "repl_depth": leader._repl_depth,
+                "delay_ms_per_leg": delay_ms,
+                "clients": n_clients,
+                "p50_repl_ack_ms": round(ack.percentile(50), 3),
+                "p99_repl_ack_ms": round(ack.percentile(99), 3),
+                **spread(reps),
+            }
+        finally:
+            nem.heal()
+            for c in clients:
+                try:
+                    await asyncio.wait_for(c.close(), 10)
+                except Exception:
+                    pass
+            for s in servers:
+                try:
+                    await asyncio.wait_for(s.close(), 10)
+                except Exception:
+                    pass
+
+    return asyncio.run(drive())
+
+
 def run_election() -> dict:
     """Config #2: forced leader churn; measures elections completed/sec.
 
@@ -1185,6 +1349,8 @@ def main() -> None:
         result = run_spi()
     elif SCENARIO == "readmix":
         result = run_readmix()
+    elif SCENARIO == "cluster":
+        result = run_cluster()
     elif SCENARIO == "session":
         result = run_session()
     elif SCENARIO in SUBMIT_BUILDERS:
@@ -1192,7 +1358,7 @@ def main() -> None:
     else:
         raise SystemExit(
             f"unknown scenario {SCENARIO!r}; pick one of "
-            f"{['election', 'map_read', 'host', 'host_read', 'spi', 'readmix', 'session', *SUBMIT_BUILDERS]}")
+            f"{['election', 'map_read', 'host', 'host_read', 'spi', 'readmix', 'cluster', 'session', *SUBMIT_BUILDERS]}")
     if degraded:
         result["degraded"] = True
     if args.metrics_json:

@@ -291,9 +291,15 @@ class NativeConnection(Connection):
         corr = self._next_id
         future: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[corr] = future
-        self._loop.send(self._fd, _REQUEST, corr,
-                        self._serializer.write(message))
-        return await future
+        try:
+            self._loop.send(self._fd, _REQUEST, corr,
+                            self._serializer.write(message))
+            return await future
+        finally:
+            # Same stranded-correlation guard as TcpConnection.send: a
+            # cancelled/timed-out send must not leak its slot in
+            # _pending until the connection closes.
+            self._pending.pop(corr, None)
 
     def _abort(self) -> None:
         self._loop._routes.pop(self._fd, None)

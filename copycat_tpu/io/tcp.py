@@ -185,9 +185,19 @@ class TcpConnection(Connection):
         corr = self._next_id
         future: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[corr] = future
-        self._write_message(_REQUEST, corr, message)
-        await self._writer.drain()
-        return await future
+        try:
+            self._write_message(_REQUEST, corr, message)
+            await self._writer.drain()
+            return await future
+        finally:
+            # A caller-side cancellation (asyncio.wait_for timeout around
+            # send — the replication and leadership-confirm paths) must
+            # not strand the correlation in _pending until the connection
+            # closes: pipelined peers issue thousands of correlated sends
+            # per connection, and each stranded future is leaked memory
+            # plus a slot the late response will never find. After a
+            # normal response the read loop already popped corr — no-op.
+            self._pending.pop(corr, None)
 
     def _abort(self) -> None:
         for future in self._pending.values():

@@ -319,6 +319,36 @@ class Log:
         if self._segment_dir is not None:
             self._persist(entry)
 
+    def append_replicated_block(self, entries: list[Entry]) -> None:
+        """Append a run of replicated entries past ``last_index`` in one
+        walk — the follower's mirror of the leader's ``append_block``.
+
+        Gap-fills compacted slots between entries (same contract as
+        ``append_replicated``), notes term boundaries once per term
+        change instead of per entry, and persists the whole block after
+        the in-memory walk. Entries must arrive in increasing index
+        order starting past the current tail (the shape one
+        AppendRequest window has after the conflict scan)."""
+        if not entries:
+            return
+        assert entries[0].index > self.last_index, \
+            f"{entries[0].index} <= {self.last_index}"
+        store = self._entries
+        index = self.last_index
+        term = self._term_starts[-1][1] if self._term_starts else None
+        for entry in entries:
+            while index + 1 < entry.index:
+                store.append(None)
+                index += 1
+            store.append(entry)
+            index += 1
+            if entry.term != term:
+                self._note_term(entry.index, entry.term)
+                term = entry.term
+        if self._segment_dir is not None:
+            for entry in entries:
+                self._persist(entry)
+
     def fill_gap(self, to_index: int) -> None:
         """Extend the log with empty (compacted-elsewhere) slots up to to_index."""
         while self.last_index < to_index:

@@ -100,6 +100,51 @@ class _EntryCtx:
         self.buffer.clear()
 
 
+class _PeerStream:
+    """Leader-side state for one follower's pipelined replication stream.
+
+    The pipeline keeps up to ``COPYCAT_REPL_DEPTH`` append windows in
+    flight over the peer connection's correlated multiplexing; this
+    object tracks the in-flight accounting (windows + entries, the
+    backpressure currency), the rewind ``epoch`` (bumped whenever a
+    consistency check fails or a window is lost, so acks from the
+    abandoned stream can no longer steer the send cursor), and the
+    adaptive window size between ``floor`` and ``ceiling``: an ack
+    latency spiking well past the EWMA baseline (a congested or slow
+    follower) halves the window toward the floor; acks near baseline
+    grow it additively back toward the ceiling — AIMD, the classic
+    shape for a windowed stream sharing a link. The baseline is an
+    EWMA, not an all-time best: a persistent RTT shift (link weather, a
+    follower moving racks) re-baselines within ~10 acks instead of
+    reading as congestion forever.
+    """
+
+    __slots__ = ("window", "floor", "ceiling", "inflight_windows",
+                 "inflight_entries", "epoch", "backoff", "ack_ewma_ms",
+                 "tasks")
+
+    def __init__(self, ceiling: int) -> None:
+        self.ceiling = max(1, ceiling)
+        self.floor = max(1, self.ceiling // 8)
+        self.window = self.ceiling  # start wide; congestion shrinks it
+        self.inflight_windows = 0
+        self.inflight_entries = 0
+        self.epoch = 0
+        self.backoff = False  # driver sleeps one beat before resuming
+        self.ack_ewma_ms = 0.0
+        self.tasks: set[asyncio.Task] = set()
+
+    def observe_ack(self, lat_ms: float) -> None:
+        if self.ack_ewma_ms == 0.0:
+            self.ack_ewma_ms = lat_ms
+        if lat_ms > 4.0 * max(self.ack_ewma_ms, 0.1):
+            self.window = max(self.floor, self.window // 2)
+        elif self.window < self.ceiling:
+            self.window = min(self.ceiling,
+                              self.window + max(1, self.ceiling // 8))
+        self.ack_ewma_ms += 0.1 * (lat_ms - self.ack_ewma_ms)
+
+
 class RaftServer(Managed):
     """A single Raft replica hosting one top-level state machine."""
 
@@ -155,7 +200,34 @@ class RaftServer(Managed):
         self._last_quorum_contact: dict[Address, float] = {}
         self._replication_events: dict[Address, asyncio.Event] = {}
         self._replication_tasks: dict[Address, asyncio.Task] = {}
+        self._peer_streams: dict[Address, _PeerStream] = {}
         self._expiring_sessions: set[int] = set()
+
+        # Pipelined replication plane (docs/REPLICATION.md): the leader
+        # keeps up to REPL_DEPTH append windows in flight per peer over
+        # the transport's correlated multiplexing instead of
+        # stop-and-wait, so leader->follower throughput is no longer
+        # capped at window/RTT. COPYCAT_REPL_PIPELINE=0 restores the
+        # stop-and-wait lane (the cluster bench's A/B knob).
+        # COPYCAT_REPL_WINDOW is BOTH the stop-and-wait window and the
+        # pipeline's initial/ceiling window size (adaptive between
+        # ceiling//8 and ceiling on ack latency); the in-flight entry
+        # cap bounds how much log a slow follower can pin.
+        self._repl_pipeline = os.environ.get(
+            "COPYCAT_REPL_PIPELINE", "1") != "0"
+        self._repl_window = max(1, int(os.environ.get(
+            "COPYCAT_REPL_WINDOW", "64")))
+        self._repl_depth = max(1, int(os.environ.get(
+            "COPYCAT_REPL_DEPTH", "8")))
+        self._repl_max_inflight = max(self._repl_window, int(os.environ.get(
+            "COPYCAT_REPL_MAX_INFLIGHT",
+            str(self._repl_window * self._repl_depth))))
+        # COPYCAT_INVARIANTS=strict (shared with the device plane's
+        # monitors): every commit advance re-verifies quorum support
+        # from match_index and raises on violation — the nemesis suite's
+        # "pipelining never outruns a real quorum" tripwire.
+        self._strict_invariants = os.environ.get(
+            "COPYCAT_INVARIANTS", "") == "strict"
 
         # apply-side bookkeeping
         self._commit_futures: dict[int, asyncio.Future] = {}  # index -> (result, error)
@@ -235,6 +307,19 @@ class RaftServer(Managed):
         self._m_query_level = {
             c.value: m.counter("query_reads", consistency=c.value)
             for c in QueryConsistency}
+        # repl.* family (docs/OBSERVABILITY.md): the replication plane.
+        # Window/entry counters + ack-latency histogram move on BOTH
+        # lanes so the cluster A/B stays attributable; the in-flight
+        # gauges and backpressure counter only move under the pipeline.
+        self._m_repl_windows = m.counter("repl.windows_sent")
+        self._m_repl_entries = m.counter("repl.entries_sent")
+        self._m_repl_window_entries = m.histogram("repl.window_entries")
+        self._m_repl_ack_ms = m.histogram("repl.ack_ms")
+        self._m_repl_rewinds = m.counter("repl.rewinds")
+        self._m_repl_stalls = m.counter("repl.stalls")
+        self._m_repl_backpressure = m.counter("repl.backpressure_waits")
+        self._m_repl_inflight_windows = m.gauge("repl.windows_inflight")
+        self._m_repl_inflight_entries = m.gauge("repl.entries_inflight")
 
         self._load_meta()
 
@@ -474,6 +559,13 @@ class RaftServer(Managed):
             task.cancel()
         self._replication_tasks.clear()
         self._replication_events.clear()
+        # drain the pipelined lanes: in-flight window sends die with the
+        # stream (their ack handling is role-gated anyway)
+        for ps in self._peer_streams.values():
+            for task in list(ps.tasks):
+                task.cancel()
+        self._peer_streams.clear()
+        self._refresh_repl_gauges()
         if self._leader_timer is not None:
             self._leader_timer.cancel()
             self._leader_timer = None
@@ -532,22 +624,53 @@ class RaftServer(Managed):
         return await fut
 
     async def _replicate_loop(self, peer: Address) -> None:
-        event = self._replication_events[peer]
         try:
-            while self.role == LEADER and not self._closing:
-                event.clear()
-                await self._replicate_once(peer)
-                if self.role != LEADER:
-                    return
-                if self.next_index.get(peer, 1) > self.log.last_index:
-                    try:
-                        await asyncio.wait_for(event.wait(), self.heartbeat_interval)
-                    except asyncio.TimeoutError:
-                        pass
+            if self._repl_pipeline:
+                await self._replicate_pipelined(peer)
+            else:
+                await self._replicate_stop_and_wait(peer)
         except asyncio.CancelledError:
             pass
         except Exception:
             logger.exception("replication loop to %s failed", peer)
+
+    # -- stop-and-wait lane (COPYCAT_REPL_PIPELINE=0): one window in
+    # -- flight per peer, the pre-pipeline behavior bit-identically —
+    # -- the cluster bench's A/B baseline
+    async def _replicate_stop_and_wait(self, peer: Address) -> None:
+        event = self._replication_events[peer]
+        while self.role == LEADER and not self._closing:
+            event.clear()
+            await self._replicate_once(peer)
+            if self.role != LEADER:
+                return
+            if self.next_index.get(peer, 1) > self.log.last_index:
+                try:
+                    await asyncio.wait_for(event.wait(), self.heartbeat_interval)
+                except asyncio.TimeoutError:
+                    pass
+
+    def _stage_window(self, next_index: int,
+                      limit: int) -> tuple[msg.AppendRequest, int, int]:
+        """Build one append window [next_index, covered_end] — shared by
+        both lanes so their wire shape can never drift apart. The end of
+        the covered index range may omit compacted (cleaned) entries:
+        they are only ever compacted once replicated to ALL members, so
+        the follower already has them (it gap-fills via ``fill_to``)."""
+        prev_index = next_index - 1
+        entries = self.log.entries_from(next_index, limit=limit)
+        covered_end = min(next_index + limit - 1, self.log.last_index)
+        request = msg.AppendRequest(
+            term=self.term, leader=self.address,
+            prev_index=prev_index, prev_term=self.log.term_at(prev_index),
+            entries=entries, commit_index=self.commit_index,
+            global_index=self.global_index,
+            fill_to=covered_end if covered_end >= next_index else None)
+        if covered_end >= next_index:
+            self._m_repl_windows.inc()
+            self._m_repl_entries.inc(len(entries))
+            self._m_repl_window_entries.record(len(entries))
+        return request, prev_index, covered_end
 
     async def _replicate_once(self, peer: Address) -> None:
         conn = await self._peer_connection(peer)
@@ -555,22 +678,13 @@ class RaftServer(Managed):
             await asyncio.sleep(self.heartbeat_interval)
             return
         next_index = self.next_index.get(peer, self.log.last_index + 1)
-        prev_index = next_index - 1
-        prev_term = self.log.term_at(prev_index)
-        entries = self.log.entries_from(next_index, limit=64)
-        # End of the index window this append covers. Compacted (cleaned)
-        # entries inside it are omitted — they are only ever compacted once
-        # replicated to ALL members, so the follower already has them.
-        covered_end = min(next_index + 63, self.log.last_index)
-        request = msg.AppendRequest(
-            term=self.term, leader=self.address,
-            prev_index=prev_index, prev_term=prev_term,
-            entries=entries, commit_index=self.commit_index,
-            global_index=self.global_index,
-            fill_to=covered_end if covered_end >= next_index else None)
+        request, prev_index, covered_end = self._stage_window(
+            next_index, self._repl_window)
+        t0 = time.perf_counter()
         try:
             response = await asyncio.wait_for(conn.send(request), self.election_timeout)
         except (TransportError, OSError, asyncio.TimeoutError):
+            self._m_repl_stalls.inc()
             await asyncio.sleep(self.heartbeat_interval)
             return
         if self.role != LEADER:
@@ -579,6 +693,7 @@ class RaftServer(Managed):
             self._become_follower(response.term, None)
             return
         self._last_quorum_contact[peer] = time.monotonic()
+        self._m_repl_ack_ms.record((time.perf_counter() - t0) * 1e3)
         if response.success:
             match = max(prev_index, covered_end)
             if match > self.match_index.get(peer, 0):
@@ -588,14 +703,177 @@ class RaftServer(Managed):
             if self.next_index[peer] <= self.log.last_index:
                 self._replication_events[peer].set()  # keep streaming
         else:
+            self._m_repl_rewinds.inc()
             hint = response.last_index if response.last_index is not None else prev_index - 1
             new_next = max(1, min(prev_index, hint + 1))
             if new_next == next_index:
                 # No rewind progress (e.g. follower in a weird state): back off
                 # instead of hot-spinning the failure path.
+                self._m_repl_stalls.inc()
                 await asyncio.sleep(self.heartbeat_interval)
             self.next_index[peer] = new_next
             self._replication_events[peer].set()
+
+    # -- pipelined lane (default): up to REPL_DEPTH windows in flight
+    # -- per peer over the transport's correlated multiplexing; acks may
+    # -- land out of order, match only moves forward, commit advances
+    # -- per ack, a failed consistency check drains + rewinds the stream
+
+    async def _replicate_pipelined(self, peer: Address) -> None:
+        event = self._replication_events[peer]
+        ps = _PeerStream(self._repl_window)
+        self._peer_streams[peer] = ps
+        try:
+            while self.role == LEADER and not self._closing:
+                conn = await self._peer_connection(peer)
+                if conn is None:
+                    await asyncio.sleep(self.heartbeat_interval)
+                    continue
+                if ps.backoff:
+                    # a lost window or a no-progress rewind: wait one beat
+                    # instead of hot-spinning the failure path
+                    ps.backoff = False
+                    await asyncio.sleep(self.heartbeat_interval)
+                    continue
+                event.clear()
+                sent = self._pump_windows(peer, ps, conn)
+                if (not sent and not ps.inflight_windows
+                        and self.next_index.get(peer, 1) > self.log.last_index):
+                    # idle stream: heartbeat cadence keeps the follower's
+                    # election timer reset and the leader lease fresh
+                    try:
+                        await asyncio.wait_for(event.wait(),
+                                               self.heartbeat_interval)
+                    except asyncio.TimeoutError:
+                        self._spawn_window(peer, ps, conn)
+                    continue
+                # streaming or backpressured: wake on the next ack (the
+                # send task sets the event) or new appends
+                try:
+                    await asyncio.wait_for(event.wait(), self.heartbeat_interval)
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            self._peer_streams.pop(peer, None)
+            for task in list(ps.tasks):
+                task.cancel()
+
+    def _pump_windows(self, peer: Address, ps: _PeerStream,
+                      conn: Connection) -> bool:
+        """Launch append windows until the stream is caught up or the
+        in-flight caps (windows, entries) push back; True if any window
+        was sent this pump."""
+        sent = False
+        while (self.role == LEADER and not self._closing
+               and ps.inflight_windows < self._repl_depth
+               and ps.inflight_entries < self._repl_max_inflight
+               and self.next_index.get(peer, 1) <= self.log.last_index):
+            self._spawn_window(peer, ps, conn)
+            sent = True
+        if (self.next_index.get(peer, 1) <= self.log.last_index
+                and (ps.inflight_windows >= self._repl_depth
+                     or ps.inflight_entries >= self._repl_max_inflight)):
+            # entries are waiting but the caps hold them back: a slow
+            # follower cannot pin unbounded log memory — count the wait
+            self._m_repl_backpressure.inc()
+        return sent
+
+    def _spawn_window(self, peer: Address, ps: _PeerStream,
+                      conn: Connection) -> None:
+        """Stage one append window [next_index, covered_end] and send it
+        without awaiting the ack (the ack lands in ``_send_window``).
+        The send cursor advances optimistically; a failed consistency
+        check or lost window rewinds it (epoch-gated)."""
+        next_index = self.next_index.get(peer, self.log.last_index + 1)
+        # clamp to the remaining in-flight entry budget so the gauge's
+        # documented bound (peers x COPYCAT_REPL_MAX_INFLIGHT) is exact —
+        # without it the last window could overshoot by window-1 entries
+        limit = min(ps.window,
+                    max(1, self._repl_max_inflight - ps.inflight_entries))
+        request, prev_index, covered_end = self._stage_window(
+            next_index, limit)
+        if covered_end >= next_index:
+            self.next_index[peer] = covered_end + 1  # optimistic cursor
+        ps.inflight_windows += 1
+        ps.inflight_entries += max(0, covered_end - prev_index)
+        self._refresh_repl_gauges()
+        task = spawn(
+            self._send_window(peer, ps, conn, request, prev_index,
+                              covered_end, ps.epoch, time.perf_counter()),
+            name="repl-window")
+        ps.tasks.add(task)
+        task.add_done_callback(ps.tasks.discard)
+
+    async def _send_window(self, peer: Address, ps: _PeerStream,
+                           conn: Connection, request: msg.AppendRequest,
+                           prev_index: int, covered_end: int, epoch: int,
+                           t0: float) -> None:
+        try:
+            response = await asyncio.wait_for(conn.send(request),
+                                              self.election_timeout)
+        except (TransportError, OSError, asyncio.TimeoutError):
+            response = None
+        finally:
+            ps.inflight_windows -= 1
+            ps.inflight_entries -= max(0, covered_end - prev_index)
+            self._refresh_repl_gauges()
+        event = self._replication_events.get(peer)
+        try:
+            if self._closing or self.role != LEADER:
+                return
+            if response is None:
+                # lost window (dead/slow link): rewind the send cursor to
+                # resend from this window's start once the link recovers;
+                # acks of the abandoned stream no longer steer the cursor
+                if epoch == ps.epoch:
+                    ps.epoch += 1
+                    ps.backoff = True
+                    self._m_repl_stalls.inc()
+                    self.next_index[peer] = min(
+                        self.next_index.get(peer, 1), prev_index + 1)
+                return
+            if response.term is not None and response.term > self.term:
+                self._become_follower(response.term, None)
+                return
+            self._last_quorum_contact[peer] = time.monotonic()
+            lat_ms = (time.perf_counter() - t0) * 1e3
+            self._m_repl_ack_ms.record(lat_ms)
+            ps.observe_ack(lat_ms)
+            if response.success:
+                # acks complete out of order: match only moves FORWARD
+                match = max(prev_index, covered_end)
+                if match > self.match_index.get(peer, 0):
+                    self.match_index[peer] = match
+                # a success ack is a safe resume point even from a stale
+                # epoch (log matching held at the follower when it acked):
+                # this heals the spurious rewind a reordered window causes
+                if match + 1 > self.next_index.get(peer, 1):
+                    self.next_index[peer] = match + 1
+                self._advance_commit()
+            else:
+                if epoch != ps.epoch:
+                    return  # the pipeline already rewound past this one
+                ps.epoch += 1  # drain: stale in-flight acks are ignored
+                self._m_repl_rewinds.inc()
+                hint = (response.last_index
+                        if response.last_index is not None
+                        else prev_index - 1)
+                new_next = max(1, min(prev_index, hint + 1))
+                if new_next >= prev_index + 1:
+                    # no rewind progress (log base reached and the
+                    # follower still refuses): back off a beat
+                    ps.backoff = True
+                    self._m_repl_stalls.inc()
+                self.next_index[peer] = new_next
+        finally:
+            if event is not None:
+                event.set()  # wake the driver: pump more / resume rewind
+
+    def _refresh_repl_gauges(self) -> None:
+        self._m_repl_inflight_windows.set(
+            sum(ps.inflight_windows for ps in self._peer_streams.values()))
+        self._m_repl_inflight_entries.set(
+            sum(ps.inflight_entries for ps in self._peer_streams.values()))
 
     def _advance_commit(self) -> None:
         if self.role != LEADER:
@@ -606,6 +884,26 @@ class RaftServer(Managed):
             reverse=True)
         candidate = matches[self.quorum - 1]
         if candidate > self.commit_index and self.log.term_at(candidate) == self.term:
+            if self._strict_invariants:
+                # COPYCAT_INVARIANTS=strict: re-verify from first
+                # principles that a REAL quorum matches the candidate —
+                # the tripwire proving pipelined (out-of-order) acks can
+                # never advance commit past actual replication. The raise
+                # may land inside a spawned ack task (logged, not fatal),
+                # so the violation ALSO counts on the registry — the
+                # strict nemesis suite asserts the counter stayed 0.
+                support = 1 + sum(1 for p in self.peers
+                                  if self.match_index.get(p, 0) >= candidate)
+                if support < self.quorum or candidate > self.log.last_index:
+                    self.metrics.counter("repl.invariant_violations").inc()
+                    logger.critical(
+                        "commit invariant violated: candidate %d supported "
+                        "by %d/%d (quorum %d, last %d)", candidate, support,
+                        len(self.members), self.quorum, self.log.last_index)
+                    raise AssertionError(
+                        f"commit invariant violated: candidate {candidate} "
+                        f"supported by {support}/{len(self.members)} "
+                        f"(quorum {self.quorum}, last {self.log.last_index})")
             self.commit_index = candidate
             self._apply_up_to(self.commit_index)
         # global index: minimum replicated position across all members
@@ -697,13 +995,15 @@ class RaftServer(Managed):
         return msg.VoteResponse(term=self.term, voted=False)
 
     async def _on_append(self, request: msg.AppendRequest) -> msg.AppendResponse:
+        if request.term < self.term:
+            # rejected before recording: appends from deposed leaders must
+            # not pollute the append-size histogram / heartbeat counter
+            return msg.AppendResponse(term=self.term, success=False,
+                                      last_index=self.log.last_index)
         if request.entries:
             self._m_append_entries.record(len(request.entries))
         else:
             self._m_heartbeats.inc()
-        if request.term < self.term:
-            return msg.AppendResponse(term=self.term, success=False,
-                                      last_index=self.log.last_index)
         if request.term > self.term or self.role != FOLLOWER:
             self._become_follower(request.term, request.leader)
         else:
@@ -725,14 +1025,30 @@ class RaftServer(Managed):
                 return msg.AppendResponse(term=self.term, success=False,
                                           last_index=self.log.last_index)
 
-        for entry in request.entries or []:
-            existing = self.log.get(entry.index)
-            if existing is not None and existing.term != entry.term:
-                self.log.truncate(entry.index)
-            if entry.index > self.log.last_index:
-                self.log.append_replicated(entry)
-            elif self.log.get(entry.index) is None and entry.index > self.last_applied:
-                self.log.set_slot(entry)
+        # Block ingest: one conflict scan over the window's prefix that
+        # overlaps the local log (skip matches, truncate at the first
+        # term conflict, fill compacted slots), then ONE
+        # append_replicated_block for the entire new tail — instead of a
+        # per-entry get/append_replicated walk (a pipelined leader
+        # delivers windows of hundreds of entries back to back, and the
+        # per-entry walk was the follower's hottest loop).
+        entries = request.entries or []
+        log = self.log
+        append_from: int | None = None
+        for k, entry in enumerate(entries):
+            if entry.index > log.last_index:
+                append_from = k
+                break
+            existing = log.get(entry.index)
+            if existing is not None:
+                if existing.term != entry.term:
+                    log.truncate(entry.index)
+                    append_from = k
+                    break
+            elif entry.index > self.last_applied:
+                log.set_slot(entry)
+        if append_from is not None:
+            log.append_replicated_block(entries[append_from:])
 
         fill_to = request.fill_to or 0
         if fill_to > self.log.last_index:

@@ -144,6 +144,70 @@ def test_mapped_crc_bounds_reordered_writeback(tmp_path):
     assert recovered2.get(5).operation == "op-4"
 
 
+def test_append_replicated_block_matches_per_entry():
+    """The follower's block ingest must land the exact structure the
+    per-entry append_replicated walk produced: same entries, same gap
+    slots, same term boundaries (term_at over compacted slots)."""
+
+    def entries():
+        out = []
+        for i, (index, term) in enumerate(
+                [(1, 1), (2, 1), (4, 2), (5, 2), (8, 3)]):  # gaps at 3, 6-7
+            e = CommandEntry(term=term, timestamp=float(i), session_id=1,
+                             seq=i + 1, operation=f"op-{index}")
+            e.index = index
+            out.append(e)
+        return out
+
+    per_entry = Storage(StorageLevel.MEMORY).build_log()
+    for e in entries():
+        per_entry.append_replicated(e)
+    block = Storage(StorageLevel.MEMORY).build_log()
+    block.append_replicated_block(entries())
+
+    assert block.last_index == per_entry.last_index == 8
+    for i in range(1, 9):
+        a, b = per_entry.get(i), block.get(i)
+        assert (a is None) == (b is None), i
+        if a is not None:
+            assert (a.index, a.term, a.operation) == \
+                (b.index, b.term, b.operation), i
+        assert per_entry.term_at(i) == block.term_at(i), i
+
+
+def test_append_replicated_block_continues_existing_log():
+    log = Storage(StorageLevel.MEMORY).build_log()
+    _fill(log, 3)
+    tail = []
+    for index in (5, 6):  # gap at 4 (compacted on the leader)
+        e = NoOpEntry(term=2, timestamp=float(index))
+        e.index = index
+        tail.append(e)
+    log.append_replicated_block(tail)
+    assert log.last_index == 6
+    assert log.get(4) is None
+    assert log.term_at(6) == 2
+    assert log.term_at(2) == 1
+    log.append_replicated_block([])  # no-op, not an error
+
+
+def test_append_replicated_block_persists(tmp_path):
+    storage = Storage(StorageLevel.MAPPED, str(tmp_path),
+                      max_entries_per_segment=4)
+    log = storage.build_log()
+    block = []
+    for index in range(1, 11):
+        e = CommandEntry(term=1, timestamp=float(index), session_id=1,
+                         seq=index, operation=f"op-{index}")
+        e.index = index
+        block.append(e)
+    log.append_replicated_block(block)
+    log.close()
+    recovered = storage.build_log()
+    assert recovered.last_index == 10
+    assert recovered.get(7).operation == "op-7"
+
+
 def test_recover_reopens_last_segment_no_small_segment_buildup(tmp_path):
     """Repeated restarts must not roll one near-empty segment per run: the
     newest segment is reopened for continued appends (DISK via append mode,
