@@ -92,6 +92,25 @@ class AtomicValueState(ResourceStateMachine):
             if session.is_open:
                 session.publish("change", value)
 
+    # -- snapshot hooks (crash-recovery plane, docs/DURABILITY.md) --------
+    # The plain register states snapshot as one value. States holding
+    # commit references that cannot round-trip — an armed TTL timer or
+    # live change listeners — opt out (NotImplemented), keeping the whole
+    # server on replay-only recovery instead of a lossy image.
+
+    def snapshot_state(self) -> Any:
+        if self._timer is not None or self._listeners:
+            return NotImplemented
+        return {"value": self.value}
+
+    def restore_state(self, data: Any, sessions: dict) -> None:
+        self.value = data["value"]
+        if self.value is not None:
+            # the owning commit is behind the snapshot boundary (entry
+            # already released): a log-less stand-in keeps the
+            # retained-commit discipline (clean() is a no-op)
+            self._current = Commit(0, None, 0.0, None, None)
+
     # -- lifecycle ---------------------------------------------------------
 
     def close(self, session: Any) -> None:

@@ -38,7 +38,13 @@ Scenarios (``COPYCAT_BENCH_SCENARIO``, BASELINE.md benchmark configs):
   "network" hides exactly the stop-and-wait stall this scenario exists
   to measure), writes through the public ``RaftClient`` API; headline
   value is committed ops/sec. The pipelined replication plane's A/B
-  knob is ``COPYCAT_REPL_PIPELINE`` (docs/REPLICATION.md).
+  knob is ``COPYCAT_REPL_PIPELINE`` (docs/REPLICATION.md); ``--storage
+  {memory,mapped,disk}`` runs the same workload on a durable log level
+  (the durability A/B, docs/DURABILITY.md).
+- ``recovery``: the crash-recovery scenario — a fresh member catching up
+  to a loaded, compacted cluster via snapshot-install streaming vs full
+  log replay (``COPYCAT_SNAPSHOTS`` A/B inside one run); headline value
+  is the catch-up speedup, with ``snap.*`` metrics in the artifact.
 """
 
 from __future__ import annotations
@@ -950,30 +956,17 @@ def run_readmix() -> dict:
     return asyncio.run(drive())
 
 
-def run_cluster() -> dict:
-    """The first replicated-cluster bench: committed ops/sec through a
-    REAL N-member ``RaftServer`` cluster (leader election, pipelined
-    AppendEntries streams, quorum commit) on the local transport, writes
-    through the public ``RaftClient`` API (micro-batched sessioned
-    commands, exactly-once seqs).
-
-    A fixed per-message-leg delay (``COPYCAT_BENCH_CLUSTER_DELAY_MS``,
-    default 2.0 ms — a realistic same-region cross-AZ RTT of ~4 ms) is
-    injected via the transport nemesis so the leader->follower
-    replication stream actually pays wire latency: stop-and-wait
-    replication (``COPYCAT_REPL_PIPELINE=0``) is then capped at
-    window/RTT entries/s per peer, which is exactly what the pipelined
-    plane exists to break. The A/B pair for PERF.md round 10 is this
-    scenario run twice, once per lane."""
-    import asyncio
-
-    from .client.client import RaftClient
-    from .io.local import LocalServerRegistry, LocalTransport
-    from .io.transport import Address
+def _cluster_machine_types():
+    """Module-registered op types + counter machine shared by the
+    ``cluster`` and ``recovery`` scenarios (serialization ids must bind
+    to ONE class each, so inline-per-scenario definitions would
+    collide)."""
+    global _ClusterAdd, _ClusterGet, _ClusterCounterMachine
+    if _ClusterAdd is not None:
+        return _ClusterAdd, _ClusterGet, _ClusterCounterMachine
     from .protocol.messages import Message
     from .protocol.operations import Command, Query
     from .io.serializer import serialize_with
-    from .server.raft import LEADER, RaftServer
     from .server.state_machine import Commit, StateMachine
 
     @serialize_with(940)
@@ -1004,6 +997,81 @@ def run_cluster() -> dict:
         def get(self, commit: "Commit") -> int:
             return self.data.get(commit.operation.key, 0)
 
+        # crash-recovery plane hooks (docs/DURABILITY.md): the recovery
+        # scenario snapshots + restores this machine; the cluster
+        # scenario's durable storage levels snapshot it too
+        def snapshot_state(self):
+            return {"data": dict(self.data)}
+
+        def restore_state(self, data, sessions) -> None:
+            self.data = dict(data["data"])
+
+    _ClusterAdd, _ClusterGet = ClusterAdd, ClusterGet
+    _ClusterCounterMachine = CounterMachine
+    return ClusterAdd, ClusterGet, CounterMachine
+
+
+_ClusterAdd = _ClusterGet = _ClusterCounterMachine = None
+
+
+def _cluster_storage_factory(level_name: str):
+    """(build_storage(i), cleanup) for a bench cluster: MEMORY needs no
+    directories; MAPPED/DISK get one temp directory per member, removed
+    by ``cleanup()``."""
+    import shutil
+    import tempfile
+
+    from .server.log import Storage, StorageLevel
+
+    level = StorageLevel(level_name)
+    if level is StorageLevel.MEMORY:
+        return (lambda i: Storage(StorageLevel.MEMORY)), (lambda: None)
+    dirs: list[str] = []
+
+    def build(i: int) -> Storage:
+        d = tempfile.mkdtemp(prefix=f"copycat-bench-{level.value}-{i}-")
+        dirs.append(d)
+        return Storage(level, d)
+
+    def cleanup() -> None:
+        for d in dirs:
+            shutil.rmtree(d, ignore_errors=True)
+
+    return build, cleanup
+
+
+def run_cluster() -> dict:
+    """The first replicated-cluster bench: committed ops/sec through a
+    REAL N-member ``RaftServer`` cluster (leader election, pipelined
+    AppendEntries streams, quorum commit) on the local transport, writes
+    through the public ``RaftClient`` API (micro-batched sessioned
+    commands, exactly-once seqs).
+
+    A fixed per-message-leg delay (``COPYCAT_BENCH_CLUSTER_DELAY_MS``,
+    default 2.0 ms — a realistic same-region cross-AZ RTT of ~4 ms) is
+    injected via the transport nemesis so the leader->follower
+    replication stream actually pays wire latency: stop-and-wait
+    replication (``COPYCAT_REPL_PIPELINE=0``) is then capped at
+    window/RTT entries/s per peer, which is exactly what the pipelined
+    plane exists to break. The A/B pair for PERF.md round 10 is this
+    scenario run twice, once per lane.
+
+    ``--storage {memory,mapped,disk}`` (env
+    ``COPYCAT_BENCH_CLUSTER_STORAGE``, default memory) runs the same
+    workload on a durable log level, so the durability A/B cost — fsync
+    policy, segment persistence, snapshot cadence — is MEASURED, with
+    the level and the ``snap.*`` family recorded in the
+    ``--metrics-json`` artifact."""
+    import asyncio
+
+    from .client.client import RaftClient
+    from .io.local import LocalServerRegistry, LocalTransport
+    from .io.transport import Address
+    from .server.raft import LEADER, RaftServer
+
+    ClusterAdd, ClusterGet, CounterMachine = _cluster_machine_types()
+    storage_level = os.environ.get(
+        "COPYCAT_BENCH_CLUSTER_STORAGE", "memory").lower()
     members = int(os.environ.get("COPYCAT_BENCH_CLUSTER_MEMBERS", "3"))
     n_clients = int(os.environ.get("COPYCAT_BENCH_CLUSTER_CLIENTS", "4"))
     ops_per_client = int(os.environ.get("COPYCAT_BENCH_CLUSTER_OPS", "1500"))
@@ -1014,13 +1082,16 @@ def run_cluster() -> dict:
     async def drive() -> dict:
         registry = LocalServerRegistry()
         addrs = [Address("local", 17000 + i) for i in range(members)]
+        build_storage, cleanup_storage = _cluster_storage_factory(
+            storage_level)
         servers = [
             RaftServer(addr, addrs,
                        LocalTransport(registry, local_address=addr),
                        CounterMachine(),
+                       storage=build_storage(i),
                        election_timeout=0.5, heartbeat_interval=0.1,
                        session_timeout=120.0)
-            for addr in addrs]
+            for i, addr in enumerate(addrs)]
         await asyncio.gather(*(s.open() for s in servers))
         deadline = time.perf_counter() + 30
         leader = None
@@ -1039,7 +1110,8 @@ def run_cluster() -> dict:
         nem = registry.attach_nemesis()
         nem.set_delay(delay_ms / 1e3)
         log(f"bench[cluster]: {members} members, {n_clients} clients x "
-            f"{ops_per_client} ops/burst, {delay_ms} ms/leg "
+            f"{ops_per_client} ops/burst, {delay_ms} ms/leg, "
+            f"storage={storage_level} "
             f"({'pipelined' if pipelined else 'stop-and-wait'} replication, "
             f"window {leader._repl_window}, depth {leader._repl_depth})")
         _bench_gc_tune()
@@ -1076,8 +1148,11 @@ def run_cluster() -> dict:
             METRICS_SNAPSHOTS["client"] = clients[0].metrics.snapshot()
             best = max(reps)
             ack = leader.metrics.histogram("repl.ack_ms")
+            raft_snap = METRICS_SNAPSHOTS["server"]["raft"]
             return {
                 "metric": (f"cluster_committed_ops_per_sec_{members}_members"
+                           + ("" if storage_level == "memory"
+                              else f"_{storage_level}")
                            + ("" if pipelined else "_stop_and_wait")),
                 "value": round(best, 1),
                 "unit": "ops/sec",
@@ -1087,6 +1162,14 @@ def run_cluster() -> dict:
                 "repl_depth": leader._repl_depth,
                 "delay_ms_per_leg": delay_ms,
                 "clients": n_clients,
+                "storage_level": storage_level,
+                "fsync": leader.storage.fsync,
+                "snapshots_enabled": bool(
+                    leader._snap_enabled and leader._snapshots is not None),
+                # the durability A/B rides the artifact: every snap.*
+                # series the leader registry holds (zeroes on memory)
+                "snap": {k: v for k, v in raft_snap.items()
+                         if k.startswith("snap.")},
                 "p50_repl_ack_ms": round(ack.percentile(50), 3),
                 "p99_repl_ack_ms": round(ack.percentile(99), 3),
                 **spread(reps),
@@ -1103,8 +1186,172 @@ def run_cluster() -> dict:
                     await asyncio.wait_for(s.close(), 10)
                 except Exception:
                     pass
+            cleanup_storage()
 
     return asyncio.run(drive())
+
+
+def run_recovery() -> dict:
+    """Crash-recovery bench (docs/DURABILITY.md): a fresh member catching
+    up to a loaded cluster, snapshot-install vs full log replay.
+
+    Two passes over the same workload on a durable storage level:
+
+    1. **snapshot** (COPYCAT_SNAPSHOTS=1): the running members snapshot at
+       the configured cadence and prefix-truncate their logs; the joiner
+       catches up via snapshot-install streaming + the retained log tail.
+    2. **replay** (COPYCAT_SNAPSHOTS=0): the replay-only plane — the
+       joiner receives every entry ever committed through the append
+       stream.
+
+    Headline value is the speedup (replay catch-up seconds / snapshot
+    catch-up seconds); the artifact carries both times, the log shapes,
+    and the leader's + joiner's full ``snap.*`` metric families."""
+    import asyncio
+
+    from .client.client import RaftClient
+    from .io.local import LocalServerRegistry, LocalTransport
+    from .io.transport import Address
+    from .server.raft import LEADER, RaftServer
+
+    ClusterAdd, ClusterGet, CounterMachine = _cluster_machine_types()
+    ops = int(os.environ.get("COPYCAT_BENCH_RECOVERY_OPS", "6000"))
+    storage_level = os.environ.get(
+        "COPYCAT_BENCH_RECOVERY_STORAGE", "disk").lower()
+    snap_entries = os.environ.get("COPYCAT_BENCH_RECOVERY_SNAP_ENTRIES",
+                                  "512")
+    n_clients = int(os.environ.get("COPYCAT_BENCH_RECOVERY_CLIENTS", "4"))
+
+    async def one_pass(snapshots_on: bool, port_base: int) -> dict:
+        saved = {k: os.environ.get(k) for k in (
+            "COPYCAT_SNAPSHOTS", "COPYCAT_SNAPSHOT_ENTRIES",
+            "COPYCAT_SNAPSHOT_RETAIN")}
+        os.environ["COPYCAT_SNAPSHOTS"] = "1" if snapshots_on else "0"
+        os.environ["COPYCAT_SNAPSHOT_ENTRIES"] = snap_entries
+        os.environ["COPYCAT_SNAPSHOT_RETAIN"] = "64"
+        build_storage, cleanup_storage = _cluster_storage_factory(
+            storage_level)
+        registry = LocalServerRegistry()
+        addrs = [Address("local", port_base + i) for i in range(3)]
+
+        def build(i: int) -> RaftServer:
+            return RaftServer(
+                addrs[i], addrs,
+                LocalTransport(registry, local_address=addrs[i]),
+                CounterMachine(), storage=build_storage(i),
+                election_timeout=0.5, heartbeat_interval=0.05,
+                session_timeout=120.0)
+
+        # seed: 2 of 3 members carry the workload (still a quorum); the
+        # third joins only at catch-up time
+        servers = [build(0), build(1)]
+        clients: list[RaftClient] = []
+        joiner = None
+        try:
+            await asyncio.gather(*(s.open() for s in servers))
+            deadline = time.perf_counter() + 30
+            leader = None
+            while time.perf_counter() < deadline:
+                leader = next((s for s in servers if s.role == LEADER), None)
+                if leader is not None:
+                    break
+                await asyncio.sleep(0.02)
+            assert leader is not None, "no leader elected"
+            clients = [RaftClient(addrs[:2], LocalTransport(registry),
+                                  session_timeout=120.0)
+                       for _ in range(n_clients)]
+            await asyncio.gather(*(c.open() for c in clients))
+            per_client = ops // n_clients
+            _bench_gc_tune()
+
+            async def pump(client: RaftClient, key: str) -> None:
+                futs = [client.submit_command_nowait(
+                    ClusterAdd(key=key, delta=1)) for _ in range(per_client)]
+                await asyncio.gather(*futs)
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*(pump(c, f"k{i}")
+                                   for i, c in enumerate(clients)))
+            seed_s = time.perf_counter() - t0
+            log(f"bench[recovery]: seeded {per_client * n_clients} ops in "
+                f"{seed_s:.2f}s ({'snapshots' if snapshots_on else 'replay'}"
+                f" pass); leader log [{leader.log.first_index}, "
+                f"{leader.log.last_index}], snap_index "
+                f"{leader._snap_index}")
+            if snapshots_on:
+                assert leader.log.prefix_index > 0, \
+                    "cadence never truncated the log — raise OPS or " \
+                    "lower COPYCAT_BENCH_RECOVERY_SNAP_ENTRIES"
+
+            # catch-up: the fresh third member boots empty and joins
+            joiner = build(2)
+            t1 = time.perf_counter()
+            await joiner.open()
+            target = leader.commit_index
+            deadline = time.perf_counter() + 120
+            while (joiner.last_applied < target
+                   and time.perf_counter() < deadline):
+                await asyncio.sleep(0.005)
+            catchup_s = time.perf_counter() - t1
+            assert joiner.last_applied >= target, \
+                (joiner.last_applied, target)
+            # correctness: the joiner's machine converged to the truth
+            assert joiner.state_machine.data.get("k0") == per_client
+            log(f"bench[recovery]: joiner caught up {target} entries in "
+                f"{catchup_s:.3f}s "
+                f"({'install+tail' if snapshots_on else 'full replay'})")
+            return {
+                "catchup_s": catchup_s,
+                "seed_s": seed_s,
+                "commit_index": target,
+                "leader_first_index": leader.log.first_index,
+                "leader_prefix_index": leader.log.prefix_index,
+                "installs_sent": leader.metrics.snapshot().get(
+                    "snap.installs_sent", 0),
+                "leader_stats": leader.stats_snapshot(),
+                "joiner_stats": joiner.stats_snapshot(),
+            }
+        finally:
+            for c in clients:
+                try:
+                    await asyncio.wait_for(c.close(), 10)
+                except Exception:
+                    pass
+            for s in servers + ([joiner] if joiner is not None else []):
+                try:
+                    await asyncio.wait_for(s.close(), 10)
+                except Exception:
+                    pass
+            cleanup_storage()
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    snap_pass = asyncio.run(one_pass(True, 17100))
+    replay_pass = asyncio.run(one_pass(False, 17200))
+    assert snap_pass["installs_sent"] >= 1, snap_pass
+    speedup = replay_pass["catchup_s"] / max(snap_pass["catchup_s"], 1e-9)
+    METRICS_SNAPSHOTS["server"] = snap_pass["leader_stats"]
+    METRICS_SNAPSHOTS["joiner"] = snap_pass["joiner_stats"]
+    return {
+        "metric": f"recovery_catchup_speedup_vs_replay_{storage_level}",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "vs_baseline": round(speedup, 4),
+        "storage_level": storage_level,
+        "snapshot_entries": int(snap_entries),
+        "seeded_ops": ops,
+        "catchup_s_snapshot": round(snap_pass["catchup_s"], 4),
+        "catchup_s_replay": round(replay_pass["catchup_s"], 4),
+        "commit_index": snap_pass["commit_index"],
+        "leader_first_index_snapshot": snap_pass["leader_first_index"],
+        "installs_sent": snap_pass["installs_sent"],
+        "snap": {k: v
+                 for k, v in snap_pass["leader_stats"]["raft"].items()
+                 if k.startswith("snap.")},
+    }
 
 
 def run_election() -> dict:
@@ -1314,7 +1561,15 @@ def main() -> None:
         "--metrics-json", default=None, metavar="PATH",
         help="write the result plus per-component metrics snapshots "
              "(server/transport/client registries) as one JSON artifact")
+    parser.add_argument(
+        "--storage", default=None, choices=["memory", "mapped", "disk"],
+        help="log storage level for the cluster/recovery scenarios "
+             "(envs COPYCAT_BENCH_CLUSTER_STORAGE / "
+             "COPYCAT_BENCH_RECOVERY_STORAGE); the durability A/B knob")
     args, _ = parser.parse_known_args()
+    if args.storage:
+        os.environ["COPYCAT_BENCH_CLUSTER_STORAGE"] = args.storage
+        os.environ["COPYCAT_BENCH_RECOVERY_STORAGE"] = args.storage
     # Probe the accelerator before any in-process backend use — a dead
     # tunnel otherwise hangs device enumeration forever. When every
     # probe fails (BENCH_r05: rc=2 after 5 probes, a whole round's
@@ -1351,6 +1606,8 @@ def main() -> None:
         result = run_readmix()
     elif SCENARIO == "cluster":
         result = run_cluster()
+    elif SCENARIO == "recovery":
+        result = run_recovery()
     elif SCENARIO == "session":
         result = run_session()
     elif SCENARIO in SUBMIT_BUILDERS:
@@ -1358,7 +1615,7 @@ def main() -> None:
     else:
         raise SystemExit(
             f"unknown scenario {SCENARIO!r}; pick one of "
-            f"{['election', 'map_read', 'host', 'host_read', 'spi', 'readmix', 'cluster', 'session', *SUBMIT_BUILDERS]}")
+            f"{['election', 'map_read', 'host', 'host_read', 'spi', 'readmix', 'cluster', 'recovery', 'session', *SUBMIT_BUILDERS]}")
     if degraded:
         result["degraded"] = True
     if args.metrics_json:

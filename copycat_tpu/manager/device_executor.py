@@ -462,6 +462,17 @@ class DeviceEngine:
         import heapq
         heapq.heappush(self._free, group)
 
+    def restore_snapshot(self, blob: bytes, next_group: int,
+                         free: list[int]) -> None:
+        """Rebuild the engine's ``RaftGroups`` from a server-plane
+        snapshot (``models/checkpoint.py`` field-path bytes) plus the
+        group-allocator bookkeeping captured with it — the device half of
+        the crash-recovery plane (docs/DURABILITY.md)."""
+        from ..models import checkpoint
+        self._groups = checkpoint.load_bytes(blob, mesh=self.config.mesh)
+        self._next_group = int(next_group)
+        self._free = sorted(int(g) for g in free)
+
     # -- op plane ----------------------------------------------------------
 
     def begin_window(self) -> DeviceWindow:
@@ -868,6 +879,34 @@ class DeviceAtomicValueState(DeviceBackedStateMachine):
             self._timer = None
         if ttl:
             self._arm_ttl(ttl)
+
+    # -- snapshot hooks (crash-recovery plane, docs/DURABILITY.md) --------
+    # The device register itself rides the engine's checkpoint blob; the
+    # host bookkeeping here is one held-value record. States with armed
+    # TTL timers or live change listeners opt OUT (NotImplemented) — they
+    # hold commit references that cannot round-trip a snapshot, and the
+    # manager then keeps the whole server on the replay-only recovery
+    # path instead of persisting a lossy image.
+
+    def snapshot_state(self) -> Any:
+        if self._timer is not None or self._listeners:
+            return NotImplemented
+        held = None
+        if self._held is not None:
+            held = {"on_device": self._held.on_device,
+                    "value": None if self._held.on_device
+                    else self._held.value}
+        return {"held": held}
+
+    def restore_state(self, data: Any, sessions: dict) -> None:
+        held = data["held"]
+        if held is not None:
+            # the creating commit is behind the snapshot boundary — its
+            # log entry is already released, so a log-less stand-in
+            # (clean() is a no-op) keeps the retained-commit discipline
+            stand_in = Commit(0, None, 0.0, None, None)
+            self._held = _Held(stand_in, value=held["value"],
+                               on_device=held["on_device"])
 
     # -- vector lane (batched server-side pump) ---------------------------
     # Eligible only in the steady device-resident state: value held ON

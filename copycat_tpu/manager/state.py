@@ -359,6 +359,106 @@ class ResourceManager(StateMachine):
         holder.state_machine.register(session)
         return instance
 
+    # -- snapshot hooks (crash-recovery plane, docs/DURABILITY.md) ---------
+
+    def snapshot_state(self) -> Any:
+        """Serialize the whole resource catalog + machine state.
+
+        Device-backed machines need no per-machine serialization: ALL of
+        their replicated state lives in the engine's ``RaftGroups``
+        pytree, captured wholesale through ``models/checkpoint.py``'s
+        field-path ``.npz`` format (one blob for every device resource).
+        CPU machines participate through their own
+        ``snapshot_state``/``restore_state`` hooks; a live CPU machine
+        WITHOUT hooks makes the whole manager opt out (returns
+        ``NotImplemented``) — the server then stays on the replay-only
+        recovery path rather than persist a lossy image.
+        """
+        resources = []
+        for rid, holder in self.resources.items():
+            machine = holder.state_machine
+            state = machine.snapshot_state()
+            if state is NotImplemented:
+                logging.getLogger(__name__).info(
+                    "resource %r (%s) cannot snapshot; manager stays "
+                    "on replay-only recovery", holder.key,
+                    type(machine).__name__)
+                return NotImplemented
+            resources.append({
+                "id": rid, "key": holder.key, "cls": holder.machine_cls,
+                "group": getattr(machine, "_group", None), "state": state})
+        instances = [
+            {"id": iid, "resource": inst.resource.resource_id,
+             "owner": inst.owner.id}
+            for iid, inst in self.instances.items()]
+        engine_blob = None
+        next_group = 0
+        free: list[int] = []
+        if self._engine is not None and self._engine._groups is not None:
+            from ..models import checkpoint
+            engine_blob = checkpoint.save_bytes(self._engine._groups)
+            next_group = self._engine._next_group
+            free = sorted(self._engine._free)
+        return {"keys": dict(self.keys), "resources": resources,
+                "instances": instances, "engine": engine_blob,
+                "engine_next_group": next_group, "engine_free": free}
+
+    def restore_state(self, data: Any, sessions: dict) -> None:
+        # build the whole catalog into locals FIRST: a failure partway
+        # (bad blob, machine restore raising) leaves this manager's live
+        # dicts untouched, so the server's full-replay fallback starts
+        # from pristine state instead of a half-restored catalog
+        engine_restored = False
+        if data["engine"] is not None and self.executor_kind == "tpu":
+            self.device_engine.restore_snapshot(
+                data["engine"], data["engine_next_group"],
+                data["engine_free"])
+            engine_restored = True
+        resources: dict[int, ResourceHolder] = {}
+        try:
+            for rec in data["resources"]:
+                machine_cls = rec["cls"]
+                if rec["group"] is not None and self.executor_kind == "tpu":
+                    from .device_executor import device_machine_for
+                    device_cls = device_machine_for(
+                        machine_cls, self.device_engine.config.resource)
+                    machine = device_cls(self.device_engine, rec["group"])
+                else:
+                    machine = machine_cls()
+                executor = ManagerResourceExecutor(
+                    self.executor, rec["id"], rec["key"])
+                machine.init(executor)
+                machine.restore_state(rec["state"], sessions)
+                resources[rec["id"]] = ResourceHolder(
+                    rec["id"], rec["key"], machine, executor,
+                    machine_cls=machine_cls)
+        except Exception:
+            if engine_restored:
+                # the full-replay fallback re-applies history from index
+                # 1; it must not land on snapshot-state device groups —
+                # drop the restored RaftGroups so the next _ensure()
+                # builds fresh
+                eng = self._engine
+                eng._groups = None
+                eng._next_group = 0
+                eng._free = []
+            raise
+        instances: dict[int, InstanceHolder] = {}
+        for rec in data["instances"]:
+            owner = sessions.get(rec["owner"])
+            holder = resources.get(rec["resource"])
+            if owner is None or holder is None:
+                continue  # the owning session died with the snapshot
+            session = ManagedResourceSession(rec["id"], owner)
+            instances[rec["id"]] = InstanceHolder(
+                rec["id"], holder, session, owner)
+            # re-register so machines that track sessions re-bind them
+            # (device machines re-attach listeners from device state)
+            holder.state_machine.register(session)
+        self.keys = dict(data["keys"])
+        self.resources = resources
+        self.instances = instances
+
     # -- observability -----------------------------------------------------
 
     def stats(self) -> dict:

@@ -12,6 +12,7 @@ the reference's session recovery).
 
 from __future__ import annotations
 
+import io
 import json
 import pathlib
 
@@ -58,15 +59,31 @@ def save(rg, path: str | pathlib.Path) -> None:
         "num_leaves": len(flat),
     }
     arrays["deliver"] = np.asarray(rg.deliver)
-    np.savez_compressed(str(path), meta=json.dumps(meta), **arrays)
+    target = path if hasattr(path, "write") else str(path)
+    np.savez_compressed(target, meta=json.dumps(meta), **arrays)
     del treedef  # structure is reconstructed from a fresh init on load
+
+
+def save_bytes(rg) -> bytes:
+    """Snapshot a ``RaftGroups`` driver to in-memory bytes (the same
+    field-path ``.npz`` format as :func:`save`) — the server-plane
+    snapshot subsystem embeds this blob for device-backed machines."""
+    bio = io.BytesIO()
+    save(rg, bio)
+    return bio.getvalue()
+
+
+def load_bytes(data: bytes, mesh=None):
+    """Restore a ``RaftGroups`` driver from :func:`save_bytes` output."""
+    return load(io.BytesIO(data), mesh=mesh)
 
 
 def load(path: str | pathlib.Path, mesh=None):
     """Restore a ``RaftGroups`` driver from a snapshot."""
     from .raft_groups import RaftGroups
 
-    with np.load(str(path), allow_pickle=False) as data:
+    source = path if hasattr(path, "read") else str(path)
+    with np.load(source, allow_pickle=False) as data:
         meta = json.loads(str(data["meta"]))
         cfg = dict(meta["config"])
         cfg["resource"] = ResourceConfig(**cfg["resource"])

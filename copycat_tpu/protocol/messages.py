@@ -244,6 +244,36 @@ class AppendResponse(Response):
     _fields = ("error", "error_detail", "term", "success", "last_index")
 
 
+@serialize_with(212)
+class InstallRequest(Message):
+    """Leader -> follower snapshot-install stream (docs/DURABILITY.md).
+
+    Sent when a follower's ``next_index`` has fallen behind the leader's
+    prefix-truncated log: the newest snapshot's payload is chunked and
+    streamed over the peer connection's correlated multiplexing (up to
+    the replication pipeline's depth of chunks in flight).  ``index`` is
+    the snapshot's applied index, ``snap_term`` the term of the entry at
+    that index (the follower's log restarts just past it), ``total`` the
+    full payload length in bytes, ``offset`` this chunk's byte position,
+    ``data`` the chunk, and ``done`` marks the final (empty) frame that
+    asks the follower to assemble + restore.
+    """
+
+    _fields = ("term", "leader", "index", "snap_term", "total", "offset",
+               "data", "done")
+
+
+@serialize_with(213)
+class InstallResponse(Response):
+    # offset: chunk acks echo the chunk's offset; a failed final assembly
+    # reports the first missing byte offset as a diagnostic. The leader's
+    # retry contract is WHOLE-RETRY (the follower clears its assembly
+    # buffer on failure) — offset is informational, not a resume cursor.
+    # last_index: the follower's log tail after a completed install.
+    _fields = ("error", "error_detail", "term", "success", "offset",
+               "last_index")
+
+
 @serialize_with(220)
 class JoinRequest(Message):
     _fields = ("member",)

@@ -3,9 +3,12 @@
 This is the always-correct reference implementation of the consensus layer the
 TPU engine (``copycat_tpu.models``) batches over groups.  Layout:
 
-- ``log``      — entry types, segmented log, Storage levels, clean()/compaction
-- ``state_machine`` — the StateMachine SPI: Commit, executor, log-time timers
+- ``log``      — entry types, segmented log, Storage levels, clean()/compaction,
+  prefix truncation behind snapshots, the fsync policy
+- ``state_machine`` — the StateMachine SPI: Commit, executor, log-time timers,
+  snapshot_state/restore_state hooks
 - ``session``  — server-side sessions: exactly-once, event push queues
+- ``snapshot`` — atomic CRC-framed snapshot files (the crash-recovery plane)
 - ``raft``     — RaftServer: roles (follower/candidate/leader), RPCs, apply loop
 """
 
@@ -21,6 +24,7 @@ from .log import (
     StorageLevel,
     UnregisterEntry,
 )
+from .snapshot import SnapshotStore
 from .state_machine import Commit, StateMachine, StateMachineContext, StateMachineExecutor
 from .session import ServerSession
 from .raft import RaftServer
@@ -41,5 +45,6 @@ __all__ = [
     "StateMachineContext",
     "StateMachineExecutor",
     "ServerSession",
+    "SnapshotStore",
     "RaftServer",
 ]

@@ -14,6 +14,8 @@ bitmap per group (``copycat_tpu.ops.logring``); this CPU log is the oracle.
 from __future__ import annotations
 
 import enum
+import json
+import logging
 import mmap
 import os
 import zlib
@@ -27,11 +29,46 @@ from ..utils.fields import compile_field_init
 class StorageLevel(enum.Enum):
     MEMORY = "memory"
     MAPPED = "mapped"  # mmap-backed segments (page-cache writes, no syscalls)
-    DISK = "disk"      # buffered files, flushed per append
+    DISK = "disk"      # buffered files, flushed (not fsynced) per append
+
+
+#: Valid ``Storage.fsync`` policies (docs/DURABILITY.md):
+#: - "never":  buffered flush per append only; data reaches the disk at the
+#:   OS's leisure (or at ``close()``). Survives process crash, not power loss.
+#: - "commit": ``Log.sync()`` fsyncs/msyncs at every point an entry becomes
+#:   part of the commit contract — when the server's commit index advances,
+#:   on followers BEFORE a success AppendResponse (the leader counts that
+#:   ack toward quorum commit; an un-fsynced ack could let a cluster-wide
+#:   power loss erase an acknowledged commit), and at segment-roll
+#:   boundaries — the default: committed (acknowledged) entries are
+#:   power-loss durable, uncommitted tail entries may be torn (which Raft
+#:   recovery tolerates by construction).
+#: - "always": fsync/msync per appended entry. Strongest and slowest.
+FSYNC_POLICIES = ("never", "commit", "always")
 
 
 class Storage:
-    """Log storage configuration (reference ``Storage`` builder equivalent)."""
+    """Log storage configuration (reference ``Storage`` builder equivalent).
+
+    Actual durability of each level (measured against a process crash /
+    a power loss, with the default ``fsync="commit"`` policy):
+
+    ============ ======================= ==================================
+    level        process crash           power loss / kernel crash
+    ============ ======================= ==================================
+    ``MEMORY``   lost (no files)         lost
+    ``MAPPED``   safe (page cache)       committed prefix safe after
+                                         ``sync()``; torn tail dropped by
+                                         the per-frame seeded CRC
+    ``DISK``     safe (flushed)          committed prefix safe after
+                                         ``sync()``; torn tail dropped by
+                                         the length-framed replay walk
+    ============ ======================= ==================================
+
+    ``fsync="never"`` downgrades the power-loss column to "lost since the
+    last roll/close"; ``fsync="always"`` upgrades it to per-entry at the
+    cost of one fsync/msync per append.
+    """
 
     def __init__(
         self,
@@ -39,11 +76,15 @@ class Storage:
         directory: str | None = None,
         max_entries_per_segment: int = 1024,
         compaction_threshold: float = 0.5,
+        fsync: str = "commit",
     ) -> None:
+        if fsync not in FSYNC_POLICIES:
+            raise ValueError(f"fsync must be one of {FSYNC_POLICIES}, got {fsync!r}")
         self.level = level
         self.directory = directory
         self.max_entries_per_segment = max_entries_per_segment
         self.compaction_threshold = compaction_threshold
+        self.fsync = fsync
 
     def build_log(self, name: str = "log") -> "Log":
         return Log(self, name)
@@ -68,11 +109,14 @@ class _MappedSegment:
     #: torn frame (header page never written back) would VALIDATE as an
     #: empty frame. Seeding makes all-zero bytes fail the check.
     #: The seed also doubles as the entry WIRE-FORMAT version stamp: bump
-    #: it whenever serialized entry bytes change shape (last: the round-4
-    #: envelope-class conversion to generic field lists), so segments
-    #: written by an older format fail CRC cleanly at frame 0 and recover
-    #: as empty instead of misparsing old bytes into wrong entries.
-    CRC_SEED = 0xA5C4
+    #: it whenever serialized entry bytes OR the segment framing change
+    #: shape (last: the trailing per-frame CRC added to DISK segments —
+    #: shared seed, so pre-CRC .seg files fail the check at their first
+    #: frame instead of misparsing the next frame's length as a CRC), so
+    #: segments written by an older format fail CRC cleanly at frame 0
+    #: and recover as empty instead of misparsing old bytes into wrong
+    #: entries.
+    CRC_SEED = 0xA5C6
 
     def __init__(self, path: str, capacity: int) -> None:
         # Exclusive create: segments are named by the entry index that
@@ -126,6 +170,11 @@ class _MappedSegment:
         self._mm[:self.HEADER] = self._used.to_bytes(self.HEADER, "little")
         return True
 
+    def flush(self) -> None:
+        """msync the mapping: everything appended so far is power-loss
+        durable (the MAPPED half of the ``fsync`` policy)."""
+        self._mm.flush()
+
     def close(self) -> None:
         self._mm.flush()
         self._mm.close()
@@ -135,11 +184,20 @@ class _MappedSegment:
     def read_payloads(path: str) -> list[bytes]:
         """CRC-valid frame payloads of a closed/crashed segment, stopping
         at the first torn frame (watermark- and checksum-bounded)."""
+        return _MappedSegment.read_payloads_ex(path)[0]
+
+    @staticmethod
+    def read_payloads_ex(path: str) -> tuple[list[bytes], bool]:
+        """``(payloads, torn)``: the CRC-valid frame payloads plus whether
+        the walk stopped BEFORE the watermark (a torn frame inside the
+        written region — recovery must then distrust everything after
+        this segment, not just this segment's tail)."""
         with open(path, "rb") as f:
             used = int.from_bytes(f.read(_MappedSegment.HEADER), "little")
             data = f.read(used)
         payloads = []
         pos = 0
+        torn = len(data) < used
         while pos + _MappedSegment.FRAME_HEADER <= len(data):
             length = int.from_bytes(data[pos:pos + 4], "little")
             crc = int.from_bytes(data[pos + 4:pos + 8], "little")
@@ -149,10 +207,11 @@ class _MappedSegment:
             # while a legitimately zero-length payload still validates.
             if (len(payload) < length
                     or zlib.crc32(payload, _MappedSegment.CRC_SEED) != crc):
+                torn = True
                 break  # torn tail: everything before it is intact
             payloads.append(payload)
             pos += _MappedSegment.FRAME_HEADER + length
-        return payloads
+        return payloads, torn or pos < used
 
 
 class Entry(object):
@@ -242,6 +301,14 @@ class Log:
     ``clean(index)`` marks an entry's effect superseded; ``compact()`` nulls
     cleaned entries that every server has applied (they are never sent again),
     freeing memory while preserving indices.
+
+    ``truncate_prefix(index)`` actually RELEASES the prefix behind a
+    state-machine snapshot (docs/DURABILITY.md): entries ``<= index`` are
+    dropped, fully-covered segment files are deleted, and
+    ``(prefix_index, prefix_term)`` is persisted in an atomic marker file so
+    recovery replays only the surviving tail.  ``term_at(prefix_index)``
+    keeps answering from the marker — AppendEntries consistency checks and
+    vote up-to-date comparisons still work at the truncation boundary.
     """
 
     def __init__(self, storage: Storage, name: str = "log") -> None:
@@ -249,6 +316,10 @@ class Log:
         self._name = name
         self._entries: list[Entry | None] = []
         self._offset = 1  # index of _entries[0]
+        # last index released by prefix truncation (0 = none) and its term;
+        # everything <= _prefix_index lives only in the snapshot now.
+        self._prefix_index = 0
+        self._prefix_term = 0
         self._cleaned: set[int] = set()
         # (start_index, term) for each term change — lets term_at() answer for
         # compacted (None) slots, which matters for AppendEntries prev-term
@@ -268,6 +339,17 @@ class Log:
     @property
     def first_index(self) -> int:
         return self._offset
+
+    @property
+    def prefix_index(self) -> int:
+        """Last index released by prefix truncation (0 = nothing released).
+        A follower whose ``next_index`` falls at or below this cannot be
+        served from the log — it needs a snapshot install."""
+        return self._prefix_index
+
+    @property
+    def prefix_term(self) -> int:
+        return self._prefix_term
 
     @property
     def last_index(self) -> int:
@@ -394,6 +476,8 @@ class Log:
         entry = self.get(index)
         if entry is not None:
             return entry.term
+        if index == self._prefix_index:
+            return self._prefix_term  # the snapshot boundary entry's term
         if index < self._offset or index > self.last_index:
             return 0
         term = 0
@@ -434,6 +518,87 @@ class Log:
             self._cleaned.discard(index)
         return reclaimed
 
+    # -- prefix truncation (snapshot plane, docs/DURABILITY.md) ------------
+
+    def truncate_prefix(self, to_index: int) -> int:
+        """Release entries ``<= to_index`` behind a state-machine snapshot;
+        returns the number of live entries dropped.  Unlike ``compact()``
+        (which nulls slots but keeps the index range), this moves the log's
+        base: recovery replays only the surviving tail, and segment files
+        wholly behind the boundary are deleted from disk."""
+        to_index = min(to_index, self.last_index)
+        if to_index < self._offset:
+            return 0
+        drop = to_index - self._offset + 1
+        released = sum(1 for e in self._entries[:drop] if e is not None)
+        # the boundary term BEFORE dropping the entries that know it
+        prefix_term = self.term_at(to_index)
+        first_term = self.term_at(to_index + 1) if to_index < self.last_index else 0
+        del self._entries[:drop]
+        self._offset = to_index + 1
+        self._prefix_index = to_index
+        self._prefix_term = prefix_term
+        self._cleaned = {i for i in self._cleaned if i > to_index}
+        self._term_starts = [(i, t) for i, t in self._term_starts if i > to_index]
+        if self._entries and first_term and (
+                not self._term_starts or self._term_starts[0][0] > self._offset):
+            self._term_starts.insert(0, (self._offset, first_term))
+        if self._segment_dir is not None:
+            self._persist_prefix()
+            self._drop_covered_segments(to_index)
+        return released
+
+    def reset_to(self, index: int, term: int) -> None:
+        """Discard the ENTIRE log and restart it just past ``index`` (a
+        snapshot install whose boundary the local log cannot match): the
+        snapshot is committed state, so everything local — including any
+        conflicting tail — is superseded or will be re-replicated."""
+        self._entries = []
+        self._offset = index + 1
+        self._prefix_index = index
+        self._prefix_term = term
+        self._cleaned = set()
+        self._term_starts = []
+        if self._segment_dir is not None:
+            self.close()
+            for fname in os.listdir(self._segment_dir):
+                if fname.startswith(f"{self._name}-") and fname.endswith((".seg", ".mseg")):
+                    os.remove(os.path.join(self._segment_dir, fname))
+            self._segment_count = 0
+            self._persist_prefix()
+
+    def _segment_starts(self) -> list[tuple[int, str]]:
+        """(first entry index, path) of every segment file, ascending."""
+        out = []
+        for fname in os.listdir(self._segment_dir):
+            if not fname.startswith(f"{self._name}-"):
+                continue
+            stem, _, ext = fname.rpartition(".")
+            if ext in ("seg", "mseg"):
+                out.append((int(stem[len(self._name) + 1:]),
+                            os.path.join(self._segment_dir, fname)))
+        return sorted(out)
+
+    def _drop_covered_segments(self, to_index: int) -> None:
+        """Delete segment files whose every entry is ``<= to_index``.  A
+        segment's coverage ends where the next one starts, so the newest
+        (active) segment is never deleted and partially-covered segments
+        stay — recovery skips their below-prefix entries via the marker."""
+        starts = self._segment_starts()
+        for k, (_, path) in enumerate(starts[:-1]):
+            if starts[k + 1][0] <= to_index + 1:
+                os.remove(path)
+
+    def sync(self) -> None:
+        """Force appended entries to stable storage (fsync/msync) — the
+        ``fsync="commit"`` policy's durability point, called by the server
+        whenever its commit index advances."""
+        if self._segment_file is not None:
+            self._segment_file.flush()
+            os.fsync(self._segment_file.fileno())
+        if self._mapped is not None:
+            self._mapped.flush()
+
     # -- disk persistence --------------------------------------------------
 
     @property
@@ -460,7 +625,7 @@ class Log:
                 roll = True  # full: close and start a segment that fits
             if roll:
                 if self._mapped is not None:
-                    self._mapped.close()
+                    self._mapped.close()  # close() msyncs: rolls are durable
                 self._mapped = _MappedSegment(
                     self._segment_path(entry.index),
                     max(self.MAPPED_SEGMENT_BYTES,
@@ -469,15 +634,30 @@ class Log:
                 if not self._mapped.append(data):
                     raise AssertionError("fresh mapped segment rejected frame")
             self._segment_count += 1
+            if self._storage.fsync == "always":
+                self._mapped.flush()
             return
-        frame = BufferOutput().write_bytes(data).to_bytes()
+        # [varint len][payload][varint crc32(payload, seed)]: the trailing
+        # seeded CRC catches torn frames whose LENGTH survived — without
+        # it, a zeroed/garbled payload tail can deserialize into a
+        # plausible-but-wrong entry and silently corrupt the state
+        # machine on replay (found by the partial_frame nemesis).
+        frame = (BufferOutput().write_bytes(data)
+                 .write_varint(zlib.crc32(data, _MappedSegment.CRC_SEED))
+                 .to_bytes())
         if self._segment_file is None or self._segment_count >= self._storage.max_entries_per_segment:
             if self._segment_file is not None:
+                if self._storage.fsync != "never":
+                    # segment-roll boundary: the closed segment is durable
+                    self._segment_file.flush()
+                    os.fsync(self._segment_file.fileno())
                 self._segment_file.close()
             self._segment_file = open(self._segment_path(entry.index), "ab")
             self._segment_count = 0
         self._segment_file.write(frame)
         self._segment_file.flush()
+        if self._storage.fsync == "always":
+            os.fsync(self._segment_file.fileno())
         self._segment_count += 1
 
     def _persist_truncate(self, from_index: int) -> None:
@@ -492,8 +672,44 @@ class Log:
             if entry is not None:
                 self._persist(entry)
 
+    @property
+    def _prefix_path(self) -> str:
+        return os.path.join(self._segment_dir, f"{self._name}.trunc")
+
+    def _persist_prefix(self) -> None:
+        """Atomically persist the prefix-truncation marker (CRC-framed so a
+        torn marker is detected, tmp+fsync+rename so it never half-writes)."""
+        from . import snapshot as snapfile
+        payload = json.dumps({"index": self._prefix_index,
+                              "term": self._prefix_term}).encode()
+        snapfile.write_atomic(self._prefix_path, snapfile.frame(payload))
+
+    def _load_prefix(self) -> None:
+        from . import snapshot as snapfile
+        path = self._prefix_path
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path, "rb") as f:
+                payload = snapfile.unframe(f.read())
+        except OSError:  # pragma: no cover - unreadable marker
+            payload = None
+        if payload is None:
+            # A corrupt marker is tolerable: segments behind the (lost)
+            # boundary were deleted, so replay just gap-fills None slots
+            # below the snapshot index and apply skips them.
+            logging.getLogger(__name__).warning(
+                "prefix marker %s corrupt; recovering without it", path)
+            return
+        meta = json.loads(payload.decode())
+        self._prefix_index = int(meta["index"])
+        self._prefix_term = int(meta["term"])
+        self._offset = self._prefix_index + 1
+
     def _recover(self) -> None:
         directory = self._storage.directory
+        self._load_prefix()
+        log = logging.getLogger(__name__)
         segments = []
         for fname in os.listdir(directory):
             if not fname.startswith(f"{self._name}-"):
@@ -503,19 +719,72 @@ class Log:
                 segments.append((int(stem[len(self._name) + 1:]), fname, ext))
         last_path = last_ext = None
         last_count = 0
+        torn = False
         for _, fname, ext in sorted(segments):
             path = os.path.join(directory, fname)
+            if torn:
+                # everything past a torn point is suspect: a gap in the
+                # entry sequence must never recover as silent None slots
+                # (replication would log-match right past them) — drop the
+                # orphaned segment; its entries re-replicate from the
+                # leader like any truncated tail
+                log.warning("log segment %s is past a torn frame; "
+                            "dropping it", path)
+                os.remove(path)
+                continue
             if ext == "mseg":
-                payloads = _MappedSegment.read_payloads(path)
+                payloads, seg_torn = _MappedSegment.read_payloads_ex(path)
+                frame_ends = None
             else:
                 with open(path, "rb") as f:
-                    buf = BufferInput(f.read())
+                    raw = f.read()
+                buf = BufferInput(raw)
                 payloads = []
+                frame_ends = []  # byte offset after each intact frame
+                seg_torn = False
                 while buf.remaining > 0:
-                    payloads.append(buf.read_bytes())
+                    try:
+                        payload = buf.read_bytes()
+                        crc = buf.read_varint()
+                    except EOFError:
+                        # torn tail (crash mid-append / dropped buffered
+                        # write): everything before it is intact — the
+                        # length-framed walk is sequential
+                        seg_torn = True
+                        break
+                    if zlib.crc32(payload, _MappedSegment.CRC_SEED) != crc:
+                        seg_torn = True
+                        break
+                    payloads.append(payload)
+                    frame_ends.append(len(raw) - buf.remaining)
+            # decode; an undecodable payload is a torn frame too (the
+            # DISK format is length-framed without a per-frame CRC)
+            entries = []
+            for k, payload in enumerate(payloads):
+                try:
+                    entries.append(self._serializer.read(payload))
+                except Exception:  # noqa: BLE001 - corrupt frame payload
+                    seg_torn = True
+                    payloads = payloads[:k]
+                    break
+            if seg_torn:
+                torn = True
+                log.warning(
+                    "log segment %s has a torn/corrupt frame; recovering "
+                    "the %d intact entries before it", path, len(entries))
+                if ext == "seg":
+                    # drop the torn bytes so continued appends never land
+                    # after garbage (the MAPPED reopen() zeroes its stale
+                    # region for the same reason)
+                    keep = frame_ends[len(payloads) - 1] if payloads else 0
+                    with open(path, "r+b") as f:
+                        f.truncate(keep)
             last_path, last_ext, last_count = path, ext, len(payloads)
-            for payload in payloads:
-                entry = self._serializer.read(payload)
+            for entry in entries:
+                if entry.index <= self._prefix_index:
+                    # a partially-covered segment: its low entries are
+                    # behind the snapshot boundary and already released
+                    continue
                 # Replayed entries keep their persisted indices.  Gap-filled
                 # (compacted-elsewhere) slots were never persisted, so recovery
                 # re-creates the gaps as None slots.

@@ -23,6 +23,7 @@ import random
 import time
 from typing import Any
 
+from ..io.serializer import Serializer
 from ..io.transport import Address, Connection, Transport, TransportError
 from ..protocol import messages as msg
 from ..protocol.operations import Command, CommandConsistency, QueryConsistency
@@ -43,6 +44,7 @@ from .log import (
     UnregisterEntry,
 )
 from .session import ServerSession, SessionState
+from .snapshot import SnapshotStore, write_atomic
 from .state_machine import Commit, StateMachine, StateMachineExecutor
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
@@ -321,7 +323,61 @@ class RaftServer(Managed):
         self._m_repl_inflight_windows = m.gauge("repl.windows_inflight")
         self._m_repl_inflight_entries = m.gauge("repl.entries_inflight")
 
+        # Crash-recovery plane (docs/DURABILITY.md): at a configurable
+        # applied-entry cadence the server serializes its state machine +
+        # session plane at last_applied into an atomic CRC-framed snapshot
+        # file, prefix-truncates the log behind it (recovery replays only
+        # the tail), and streams the snapshot to followers whose
+        # next_index fell behind the truncated log (InstallRequest chunks
+        # riding the replication pipeline). COPYCAT_SNAPSHOTS=0 restores
+        # the replay-only lane bit-identically (the recovery A/B knob).
+        self._snap_enabled = os.environ.get("COPYCAT_SNAPSHOTS", "1") != "0"
+        self._snap_every = max(1, int(os.environ.get(
+            "COPYCAT_SNAPSHOT_ENTRIES", "1024")))
+        # entries kept BEHIND the snapshot boundary so slightly-lagging
+        # followers catch up from the log instead of paying an install;
+        # the default covers the replication pipeline's whole in-flight
+        # budget — a healthy follower's lag under backpressure is bounded
+        # by COPYCAT_REPL_MAX_INFLIGHT, so truncation never outruns it
+        self._snap_retain = max(0, int(os.environ.get(
+            "COPYCAT_SNAPSHOT_RETAIN", str(max(64, self._repl_max_inflight)))))
+        self._snap_chunk = max(4096, int(os.environ.get(
+            "COPYCAT_SNAP_CHUNK", str(256 * 1024))))
+        self._snapshots: SnapshotStore | None = None
+        if self.storage.directory:
+            self._snapshots = SnapshotStore(
+                self.storage.directory, f"{name}-{address.port}")
+        self._snap_serializer = Serializer()
+        self._snap_index = 0           # applied index of the newest snapshot
+        self._snap_supported = True    # cleared when the machine opts out
+        self._installing: dict | None = None  # follower-side chunk assembly
+        self._install_term_cache: tuple[int, int] | None = None
+        self._fsync_on_commit = (
+            self.storage.fsync == "commit"
+            and self.storage.level is not StorageLevel.MEMORY)
+        # snap.* family (docs/OBSERVABILITY.md)
+        self._m_snap_taken = m.counter("snap.snapshots_taken")
+        self._m_snap_bytes = m.counter("snap.snapshot_bytes")
+        self._m_snap_ms = m.histogram("snap.snapshot_ms")
+        self._m_snap_trunc = m.counter("snap.truncated_entries")
+        self._m_snap_chunks_sent = m.counter("snap.install_chunks_sent")
+        self._m_snap_chunks_recv = m.counter("snap.install_chunks_received")
+        self._m_snap_installs_sent = m.counter("snap.installs_sent")
+        self._m_snap_installs_recv = m.counter("snap.installs_received")
+        self._m_snap_install_fail = m.counter("snap.install_failures")
+        self._m_snap_restores = m.counter("snap.restores")
+        self._m_snap_restore_ms = m.histogram("snap.restore_ms")
+        self._m_snap_meta_fallback = m.counter("snap.meta_fallbacks")
+        # boot-tail replay accounting: cumulative _apply_up_to time until
+        # the log tail that survived the restart is fully re-applied
+        self._recovery_replay_s = 0.0
+        self._recovery_boot_last = 0
+
         self._load_meta()
+        self._boot_recover()
+        self._recovery_boot_last = (
+            self.log.last_index if self.log.last_index > self.last_applied
+            else 0)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -387,20 +443,205 @@ class RaftServer(Managed):
         return None
 
     def _persist_meta(self) -> None:
+        # tmp + fsync + atomic rename: a torn (term, voted_for) write is a
+        # Raft SAFETY hazard — a lost vote record lets this server vote
+        # twice in the same term after a restart, electing two leaders.
         path = self._meta_path
         if path:
-            with open(path, "w") as f:
-                json.dump({"term": self.term,
-                           "voted_for": str(self.voted_for) if self.voted_for else None}, f)
+            write_atomic(path, json.dumps(
+                {"term": self.term,
+                 "voted_for": str(self.voted_for) if self.voted_for else None}
+            ).encode())
 
     def _load_meta(self) -> None:
         path = self._meta_path
-        if path and os.path.exists(path):
+        if not path or not os.path.exists(path):
+            return
+        try:
             with open(path) as f:
                 meta = json.load(f)
-            self.term = meta.get("term", 0)
+            self.term = int(meta.get("term", 0))
             voted = meta.get("voted_for")
             self.voted_for = Address.parse(voted) if voted else None
+        except (json.JSONDecodeError, ValueError, KeyError, OSError) as e:
+            # A corrupt/truncated meta file (a torn write from a pre-atomic
+            # version, or disk damage) must not kill the boot: fall back to
+            # zero-state — conservative for elections (this server may
+            # re-vote in a term it already voted in, which the atomic
+            # writer above makes vanishingly unlikely to matter) — and
+            # leave a loud trail: log, counter, and a flight-recorder note
+            # when the device telemetry hub is reachable.
+            logger.warning("%s meta file %s corrupt (%s); booting with "
+                           "zero vote state", self.name, path, e)
+            self._m_snap_meta_fallback.inc()
+            self._flight_note("meta_corrupt", path=path, error=str(e))
+            self.term = 0
+            self.voted_for = None
+
+    def _flight_note(self, kind: str, **fields) -> None:
+        """Best-effort note in the device-plane flight recorder (the ring
+        ``testing/nemesis.py`` faults also land in), so a recovery anomaly
+        sits next to whatever fault caused it in one /flight dump."""
+        try:
+            engine = getattr(self.state_machine, "_engine", None)
+            groups = getattr(engine, "_groups", None)
+            hub = getattr(groups, "telemetry", None)
+            if hub is not None:
+                hub.flight.record(kind, getattr(groups, "rounds", 0), **fields)
+        except Exception:  # noqa: BLE001 - observability must never wound
+            pass
+
+    # ------------------------------------------------------------------
+    # snapshot capture / restore (crash-recovery plane)
+    # ------------------------------------------------------------------
+
+    def _wire_session(self, session: ServerSession) -> None:
+        """Route the session's publish through touched-session tracking /
+        the windowed-apply publish buffer (installed at register-apply
+        time AND at snapshot restore — restored sessions must publish
+        exactly like never-crashed ones)."""
+        original_publish = session.publish
+
+        def tracked_publish(event: str, message: Any = None,
+                            _orig=original_publish, _s=session) -> None:
+            buf = self._publish_buffer
+            if buf is not None:
+                # windowed apply: buffered, replayed in log order at the
+                # entry's finalization (chains complete out of order)
+                buf.append((_orig, event, message, _s))
+            else:
+                _orig(event, message)
+                self._session_touched(_s)
+
+        session.publish = tracked_publish  # type: ignore[method-assign]
+
+    def _snapshot_payload(self) -> bytes | None:
+        """Serialize the full replicated image at ``last_applied``, or
+        ``None`` when the state machine opts out of snapshotting."""
+        machine_state = self.state_machine.snapshot_state()
+        if machine_state is NotImplemented:
+            if self._snap_supported:
+                self._snap_supported = False
+                logger.info(
+                    "%s state machine %s does not support snapshots; "
+                    "staying on the replay-only recovery path", self.name,
+                    type(self.state_machine).__name__)
+            return None
+        payload = {
+            "version": 1,
+            "index": self.last_applied,
+            "term": self.log.term_at(self.last_applied) or self.term,
+            "clock": self.context.clock,
+            "members": [str(m) for m in self.members],
+            "sessions": [s.snapshot_dict() for s in self.sessions.values()],
+            "machine": machine_state,
+        }
+        return self._snap_serializer.write(payload)
+
+    def _take_snapshot(self) -> bool:
+        """Capture + persist one snapshot at ``last_applied``, then release
+        the log prefix behind it (keeping ``COPYCAT_SNAPSHOT_RETAIN``
+        entries so slightly-lagging followers avoid an install)."""
+        index = self.last_applied
+        t0 = time.perf_counter()
+        try:
+            data = self._snapshot_payload()
+            if data is None:
+                return False
+            self._snapshots.save(index, data)
+            self._snapshots.gc(keep=2)
+            self._snap_index = index
+            self._m_snap_taken.inc()
+            self._m_snap_bytes.inc(len(data))
+            self._m_snap_ms.record((time.perf_counter() - t0) * 1e3)
+            released = self.log.truncate_prefix(index - self._snap_retain)
+            self._m_snap_trunc.inc(released)
+        except Exception:  # noqa: BLE001 - capture must never kill apply
+            # serialization bugs AND storage I/O (disk full, EIO on the
+            # tmp write/rename, segment deletion): the apply/commit path
+            # that called us must keep running either way
+            logger.exception("%s snapshot capture at %d failed", self.name,
+                             index)
+            self._flight_note("snapshot_failed", index=index)
+            return False
+        logger.debug("%s snapshot at %d (%d bytes, %d entries released)",
+                     self.name, index, len(data), released)
+        return True
+
+    def _maybe_snapshot(self) -> None:
+        if (self._snap_enabled and self._snap_supported
+                and self._snapshots is not None
+                and self.last_applied - self._snap_index >= self._snap_every):
+            self._take_snapshot()
+
+    def _boot_recover(self) -> None:
+        """Load the newest valid snapshot and restore state at boot, so the
+        log tail — not the whole history — is all that replays (recovery
+        time bounded by the snapshot cadence).  With COPYCAT_SNAPSHOTS=0
+        this is a no-op: the replay-only path, bit-identically."""
+        if not self._snap_enabled or self._snapshots is None:
+            return
+        snap = self._snapshots.newest()
+        if snap is None:
+            return
+        index, data = snap
+        try:
+            payload = self._snap_serializer.read(data)
+            self._restore_snapshot(payload)
+        except Exception:  # noqa: BLE001 - fall back to full replay
+            logger.exception("%s snapshot restore at %d failed; falling "
+                             "back to full replay", self.name, index)
+            self._flight_note("snapshot_restore_failed", index=index)
+            # scrub anything a partial restore touched before replaying
+            # from zero — replaying onto half-restored sessions/clock
+            # would silently diverge this member (the machine hooks are
+            # ordered to mutate last, see _restore_snapshot)
+            self.sessions.clear()
+            self.context.clock = 0.0
+            self.last_applied = 0
+            self.commit_index = 0
+            self._snap_index = 0
+
+    def _restore_snapshot(self, payload: dict) -> None:
+        """Install one decoded snapshot image (boot recovery and the
+        follower side of install streaming share this path)."""
+        t0 = time.perf_counter()
+        index = payload["index"]
+        term = payload["term"]
+        # decode EVERYTHING decodable into locals before the first
+        # mutation of self, so a malformed image fails fast with this
+        # server still pristine (the boot path then falls back to full
+        # replay cleanly; the install path refuses the chunk)
+        members = [Address.parse(m) for m in payload["members"]]
+        restored = [ServerSession.from_snapshot(s)
+                    for s in payload["sessions"]]
+        self.context.clock = payload["clock"]
+        if members:
+            self.members = members
+        # session plane: replicated halves restored, publish re-wired; the
+        # dict object is shared with context.sessions — mutate in place
+        self.sessions.clear()
+        for session in restored:
+            self._wire_session(session)
+            self.sessions[session.id] = session
+        self.state_machine.restore_state(payload["machine"], self.sessions)
+        # log alignment: keep a matching tail, otherwise restart past the
+        # snapshot boundary (Raft snapshot-install rule)
+        log = self.log
+        if log.last_index > index and log.term_at(index) in (0, term) \
+                and log.first_index <= index + 1:
+            if log.prefix_index < index - self._snap_retain:
+                self._m_snap_trunc.inc(
+                    log.truncate_prefix(index - self._snap_retain))
+        elif log.last_index != index or log.term_at(index) not in (0, term) \
+                or log.first_index > index + 1:
+            log.reset_to(index, term)
+        self.last_applied = index
+        self.commit_index = max(self.commit_index, index)
+        self._snap_index = index
+        self._m_snap_restores.inc()
+        self._m_snap_restore_ms.record((time.perf_counter() - t0) * 1e3)
+        self._applied_event.set()
 
     # ------------------------------------------------------------------
     # connections
@@ -409,6 +650,7 @@ class RaftServer(Managed):
     def _accept(self, connection: Connection) -> None:
         connection.handler(msg.VoteRequest, self._on_vote)
         connection.handler(msg.AppendRequest, self._on_append)
+        connection.handler(msg.InstallRequest, self._on_install)
         connection.handler(msg.RegisterRequest, lambda m: self._on_register(connection, m))
         connection.handler(msg.KeepAliveRequest, lambda m: self._on_keepalive(connection, m))
         connection.handler(msg.UnregisterRequest, self._on_unregister)
@@ -678,6 +920,11 @@ class RaftServer(Managed):
             await asyncio.sleep(self.heartbeat_interval)
             return
         next_index = self.next_index.get(peer, self.log.last_index + 1)
+        if next_index <= self.log.prefix_index:
+            # the entries this follower needs were released behind a
+            # snapshot: stream the snapshot, then resume appending
+            await self._install_to_peer(peer, conn)
+            return
         request, prev_index, covered_end = self._stage_window(
             next_index, self._repl_window)
         t0 = time.perf_counter()
@@ -734,6 +981,22 @@ class RaftServer(Managed):
                     # instead of hot-spinning the failure path
                     ps.backoff = False
                     await asyncio.sleep(self.heartbeat_interval)
+                    continue
+                if self.next_index.get(peer, 1) <= self.log.prefix_index:
+                    # follower fell behind the prefix-truncated log: the
+                    # append stream cannot serve it — drain in-flight
+                    # windows, then stream the snapshot through the same
+                    # connection (chunks ride the correlated multiplexing
+                    # with the stream's depth + AIMD accounting), and
+                    # resume appending where the snapshot ends
+                    if ps.inflight_windows:
+                        try:
+                            await asyncio.wait_for(event.wait(),
+                                                   self.heartbeat_interval)
+                        except asyncio.TimeoutError:
+                            pass
+                        continue
+                    await self._install_to_peer(peer, conn, ps)
                     continue
                 event.clear()
                 sent = self._pump_windows(peer, ps, conn)
@@ -875,6 +1138,118 @@ class RaftServer(Managed):
         self._m_repl_inflight_entries.set(
             sum(ps.inflight_entries for ps in self._peer_streams.values()))
 
+    # -- snapshot-install streaming (leader side) ----------------------
+
+    async def _install_to_peer(self, peer: Address, conn: Connection,
+                               ps: _PeerStream | None = None) -> bool:
+        """Stream the newest snapshot to a follower whose ``next_index``
+        fell behind the prefix-truncated log, then point the append
+        stream just past the snapshot.  Chunks ride the connection's
+        correlated multiplexing — up to the pipeline depth in flight
+        (one at a time on the stop-and-wait lane) with each ack feeding
+        the stream's AIMD/EWMA accounting.  Any failed or refused chunk
+        aborts the attempt; the driver loop retries from scratch on its
+        next beat (installs are rare and whole-retry keeps the follower
+        assembly state trivial)."""
+        snap = (self._snapshots.newest()
+                if self._snap_enabled and self._snapshots is not None
+                else None)
+        if snap is None:
+            # a prefix-truncated log with no readable snapshot cannot
+            # serve this follower at all — operator-level damage
+            logger.error("%s: follower %s needs entries <= %d but no "
+                         "valid snapshot exists", self.name, peer,
+                         self.log.prefix_index)
+            self._m_snap_install_fail.inc()
+            await asyncio.sleep(self.heartbeat_interval)
+            return False
+        index, payload = snap
+        # boundary-term lookup without re-decoding the (possibly large)
+        # payload on every attempt: cached per snapshot index
+        cached = self._install_term_cache
+        if cached is not None and cached[0] == index:
+            snap_term = cached[1]
+        else:
+            try:
+                snap_term = self._snap_serializer.read(payload)["term"]
+            except Exception:  # noqa: BLE001 - corrupt-but-CRC-valid payload
+                logger.exception("%s: snapshot %d undecodable", self.name,
+                                 index)
+                self._m_snap_install_fail.inc()
+                await asyncio.sleep(self.heartbeat_interval)
+                return False
+            self._install_term_cache = (index, snap_term)
+        term = self.term
+        total = len(payload)
+        chunk = self._snap_chunk
+        sem = asyncio.Semaphore(self._repl_depth if ps is not None else 1)
+        failed = False
+
+        async def send_chunk(offset: int) -> None:
+            nonlocal failed
+            async with sem:
+                if failed or self.role != LEADER or self._closing:
+                    failed = True
+                    return
+                t0 = time.perf_counter()
+                try:
+                    response = await asyncio.wait_for(
+                        conn.send(msg.InstallRequest(
+                            term=term, leader=self.address, index=index,
+                            snap_term=snap_term, total=total, offset=offset,
+                            data=payload[offset:offset + chunk], done=False)),
+                        self.election_timeout)
+                except (TransportError, OSError, asyncio.TimeoutError):
+                    failed = True
+                    return
+                if response.term is not None and response.term > self.term:
+                    self._become_follower(response.term, None)
+                    failed = True
+                    return
+                if not response.success:
+                    failed = True
+                    return
+                self._m_snap_chunks_sent.inc()
+                self._last_quorum_contact[peer] = time.monotonic()
+                if ps is not None:
+                    ps.observe_ack((time.perf_counter() - t0) * 1e3)
+
+        await asyncio.gather(
+            *(send_chunk(o) for o in range(0, total, chunk)))
+        if not failed and self.role == LEADER and not self._closing:
+            # final frame: the follower assembles, CRC-persists, restores
+            try:
+                response = await asyncio.wait_for(
+                    conn.send(msg.InstallRequest(
+                        term=term, leader=self.address, index=index,
+                        snap_term=snap_term, total=total, offset=total,
+                        data=b"", done=True)),
+                    self.election_timeout * 4)
+            except (TransportError, OSError, asyncio.TimeoutError):
+                failed = True
+            else:
+                if response.term is not None and response.term > self.term:
+                    self._become_follower(response.term, None)
+                    failed = True
+                elif not response.success:
+                    failed = True
+        if failed or self.role != LEADER:
+            self._m_snap_install_fail.inc()
+            if ps is not None:
+                ps.backoff = True
+            else:
+                await asyncio.sleep(self.heartbeat_interval)
+            return False
+        self._m_snap_installs_sent.inc()
+        self._last_quorum_contact[peer] = time.monotonic()
+        if index > self.match_index.get(peer, 0):
+            self.match_index[peer] = index
+        self.next_index[peer] = max(self.next_index.get(peer, 1), index + 1)
+        logger.info("%s installed snapshot %d on %s (%d bytes)", self.name,
+                    index, peer, total)
+        self._advance_commit()
+        return True
+
     def _advance_commit(self) -> None:
         if self.role != LEADER:
             return
@@ -905,6 +1280,8 @@ class RaftServer(Managed):
                         f"supported by {support}/{len(self.members)} "
                         f"(quorum {self.quorum}, last {self.log.last_index})")
             self.commit_index = candidate
+            if self._fsync_on_commit:
+                self.log.sync()  # commit boundary: acknowledged = durable
             self._apply_up_to(self.commit_index)
         # global index: minimum replicated position across all members
         if self.peers:
@@ -1049,6 +1426,14 @@ class RaftServer(Managed):
                 log.set_slot(entry)
         if append_from is not None:
             log.append_replicated_block(entries[append_from:])
+            if self._fsync_on_commit:
+                # the success ack below is what the leader counts toward
+                # quorum commit: it must not rest on page-cache-only
+                # bytes, or a cluster-wide power loss could erase an
+                # acknowledged commit (a quorum of un-fsynced ackers
+                # reboots without the entry and re-elects among
+                # themselves) — sync BEFORE acking, per append window
+                self.log.sync()
 
         fill_to = request.fill_to or 0
         if fill_to > self.log.last_index:
@@ -1057,12 +1442,84 @@ class RaftServer(Managed):
         commit = min(request.commit_index or 0, self.log.last_index)
         if commit > self.commit_index:
             self.commit_index = commit
+            if self._fsync_on_commit:
+                self.log.sync()  # commit boundary: acknowledged = durable
             self._apply_up_to(commit)
         global_index = getattr(request, "global_index", None)
         if global_index:
             self.log.compact(min(global_index, self.last_applied))
         return msg.AppendResponse(term=self.term, success=True,
                                   last_index=self.log.last_index)
+
+    async def _on_install(self, request: msg.InstallRequest
+                          ) -> msg.InstallResponse:
+        """Follower side of snapshot-install streaming: buffer chunks by
+        offset, and on the final frame assemble, persist (atomic +
+        CRC-framed, via the local snapshot store when one exists), restore
+        the image, and restart the log just past it."""
+        if request.term < self.term:
+            return msg.InstallResponse(term=self.term, success=False)
+        if not self._snap_enabled:
+            # COPYCAT_SNAPSHOTS=0 pins this server to the replay-only
+            # lane; a mixed-knob cluster surfaces loudly instead of
+            # half-restoring
+            return msg.InstallResponse(
+                term=self.term, success=False, error=msg.INTERNAL,
+                error_detail="snapshots disabled on this member")
+        if request.term > self.term or self.role != FOLLOWER:
+            self._become_follower(request.term, request.leader)
+        else:
+            self.leader_address = request.leader
+            self._reset_election_timer()
+        if request.index <= self.last_applied:
+            # stale install (we caught up some other way): ack so the
+            # leader's cursor advances past it
+            return msg.InstallResponse(term=self.term, success=True,
+                                       last_index=self.log.last_index)
+        buf = self._installing
+        if buf is None or buf["index"] != request.index:
+            buf = self._installing = {"index": request.index,
+                                      "term": request.snap_term,
+                                      "total": request.total, "chunks": {}}
+        if request.data:
+            buf["chunks"][request.offset] = request.data
+            self._m_snap_chunks_recv.inc()
+        if not request.done:
+            return msg.InstallResponse(term=self.term, success=True,
+                                       offset=request.offset)
+        # final frame: verify the byte range is contiguous and complete
+        parts = sorted(buf["chunks"].items())
+        pos = 0
+        for offset, data in parts:
+            if offset != pos:
+                break
+            pos = offset + len(data)
+        if pos != buf["total"]:
+            self._installing = None  # whole-retry contract (leader side)
+            return msg.InstallResponse(term=self.term, success=False,
+                                       offset=pos)
+        payload_bytes = b"".join(data for _, data in parts)
+        self._installing = None
+        try:
+            payload = self._snap_serializer.read(payload_bytes)
+            if self._snapshots is not None:
+                self._snapshots.save(request.index, payload_bytes)
+                self._snapshots.gc(keep=2)
+            self._restore_snapshot(payload)
+        except Exception as e:  # noqa: BLE001 - refuse, don't die
+            logger.exception("%s: snapshot install at %d failed",
+                             self.name, request.index)
+            self._flight_note("install_failed", index=request.index)
+            self._m_snap_install_fail.inc()
+            return msg.InstallResponse(term=self.term, success=False,
+                                       error=msg.INTERNAL,
+                                       error_detail=str(e))
+        self._m_snap_installs_recv.inc()
+        self._flight_note("snapshot_installed", index=request.index)
+        logger.info("%s restored installed snapshot at %d", self.name,
+                    request.index)
+        return msg.InstallResponse(term=self.term, success=True,
+                                   last_index=self.log.last_index)
 
     # ------------------------------------------------------------------
     # RPC handlers: membership
@@ -1721,6 +2178,7 @@ class RaftServer(Managed):
     # ------------------------------------------------------------------
 
     def _apply_up_to(self, commit_index: int) -> None:
+        t_replay = time.perf_counter() if self._recovery_boot_last else 0.0
         window = None
         route = None
         if self.last_applied < commit_index:
@@ -1783,7 +2241,17 @@ class RaftServer(Managed):
                     window.close()
                 except Exception:
                     logger.exception("device window close failed")
+        if self._recovery_boot_last:
+            # boot-tail replay accounting: cumulative apply time until the
+            # restart's surviving log tail is fully re-applied — the
+            # number the snapshot cadence bounds (snap.recovery_replay_ms)
+            self._recovery_replay_s += time.perf_counter() - t_replay
+            if self.last_applied >= self._recovery_boot_last:
+                self.metrics.gauge("snap.recovery_replay_ms").set(
+                    self._recovery_replay_s * 1e3)
+                self._recovery_boot_last = 0
         self._applied_event.set()
+        self._maybe_snapshot()
 
     # -- batched server-side pump (the vector lane) --------------------
 
@@ -2001,20 +2469,7 @@ class RaftServer(Managed):
         session = ServerSession(entry.index, entry.client_id, entry.timeout)
         session.last_keepalive_time = self.context.clock
         # Wire publish -> touched-session tracking for this apply step.
-        original_publish = session.publish
-
-        def tracked_publish(event: str, message: Any = None,
-                            _orig=original_publish, _s=session) -> None:
-            buf = self._publish_buffer
-            if buf is not None:
-                # windowed apply: buffered, replayed in log order at the
-                # entry's finalization (chains complete out of order)
-                buf.append((_orig, event, message, _s))
-            else:
-                _orig(event, message)
-                self._session_touched(_s)
-
-        session.publish = tracked_publish  # type: ignore[method-assign]
+        self._wire_session(session)
         self.sessions[entry.index] = session
         if self.role == LEADER:
             session.last_contact = time.monotonic()
@@ -2172,6 +2627,15 @@ class RaftServer(Managed):
             queue_depth += len(session.event_queue)
         m.gauge("sessions_open").set(live)
         m.gauge("session_event_queue_depth").set(queue_depth)
+        # snapshot plane (docs/DURABILITY.md): where the durable image
+        # stands relative to the log, and whether any file was skipped
+        # for a bad CRC since boot
+        m.gauge("snap.last_snapshot_index").set(self._snap_index)
+        m.gauge("snap.log_first_index").set(self.log.first_index)
+        m.gauge("snap.enabled").set(
+            1 if (self._snap_enabled and self._snapshots is not None) else 0)
+        if self._snapshots is not None:
+            m.gauge("snap.bad_crc_skipped").set(self._snapshots.bad_skipped)
         snap: dict = {
             "node": str(self.address),
             "role": self.role,

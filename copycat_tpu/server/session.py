@@ -102,6 +102,43 @@ class ServerSession:
         for seq in [s for s in self.responses if s <= command_seq]:
             del self.responses[seq]
 
+    # -- snapshot round-trip (crash-recovery plane) ------------------------
+
+    def snapshot_dict(self) -> dict:
+        """The REPLICATED half of this session as a serializer-writable
+        dict (leader-local state — connection, futures, pending ops — is
+        deliberately absent: it is rebuilt by live traffic, the same
+        contract as leader failover)."""
+        return {
+            "id": self.id,
+            "client_id": self.client_id,
+            "timeout": self.timeout,
+            "state": self.state.value,
+            "command_high": self.command_high,
+            "responses": {seq: list(r) for seq, r in self.responses.items()},
+            "event_index": self.event_index,
+            "event_ack_index": self.event_ack_index,
+            "event_queue": [
+                (b.event_index, b.prev_event_index, list(b.events))
+                for b in self.event_queue],
+            "last_keepalive_time": self.last_keepalive_time,
+        }
+
+    @classmethod
+    def from_snapshot(cls, data: dict) -> "ServerSession":
+        session = cls(data["id"], data["client_id"], data["timeout"])
+        session.state = SessionState(data["state"])
+        session.command_high = data["command_high"]
+        session.responses = {seq: tuple(r)
+                             for seq, r in data["responses"].items()}
+        session.event_index = data["event_index"]
+        session.event_ack_index = data["event_ack_index"]
+        session.event_queue = [
+            EventBatch(ei, prev, [tuple(e) for e in events])
+            for ei, prev, events in data["event_queue"]]
+        session.last_keepalive_time = data["last_keepalive_time"]
+        return session
+
     # -- lifecycle ---------------------------------------------------------
 
     @property

@@ -209,6 +209,27 @@ class StateMachine:
             if executor.callback_for(op_type) is None:
                 executor.register(op_type, getattr(self, name))
 
+    # -- snapshot hooks (crash-recovery plane, docs/DURABILITY.md) --------
+
+    def snapshot_state(self) -> Any:
+        """Serializer-writable image of this machine's replicated state at
+        the current applied index, or ``NotImplemented`` (the default) when
+        the machine cannot be snapshotted — the server then skips snapshot
+        capture entirely rather than persist a lossy image.
+
+        Contract for implementers: the returned object must round-trip
+        through ``io.serializer.Serializer`` (primitives, containers,
+        bytes, registered classes), and machines owning log-time timers
+        must include enough information to RE-SCHEDULE them in
+        :meth:`restore_state` (deadlines are absolute log-clock values;
+        re-schedule with ``deadline - context.clock``)."""
+        return NotImplemented
+
+    def restore_state(self, data: Any, sessions: dict[int, Any]) -> None:
+        """Rebuild replicated state from a :meth:`snapshot_state` image.
+        ``sessions`` is the restored session table (id -> ServerSession) so
+        machines tracking sessions can re-bind them by id."""
+
     # -- session lifecycle hooks (SURVEY.md §3.4) -------------------------
 
     def register(self, session: Any) -> None:

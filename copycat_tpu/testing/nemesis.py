@@ -1,17 +1,31 @@
-"""Fault injection for the batched consensus step.
+"""Fault injection: the device-plane nemesis and the host storage nemesis.
 
-Faults are ``deliver[g, from, to]`` boolean masks consumed *inside* the
-compiled step (``ops/consensus.py`` masks every exchange), so partitions
-and message loss run at full batch speed — the reference's fake-transport
-test strategy (SURVEY.md §4, `LocalTransport`) plus the Jepsen nemesis the
-reference outsources, fused into the XLA program.
+Device plane (:class:`Nemesis`): faults are ``deliver[g, from, to]``
+boolean masks consumed *inside* the compiled step (``ops/consensus.py``
+masks every exchange), so partitions and message loss run at full batch
+speed — the reference's fake-transport test strategy (SURVEY.md §4,
+`LocalTransport`) plus the Jepsen nemesis the reference outsources, fused
+into the XLA program.
+
+Host plane (:func:`crash_server` + :class:`StorageNemesis`): the
+crash/torn-write family over a server's storage directory — SIGKILL-shaped
+stops, torn segment tails, zeroed frame pages, dropped fsyncs, corrupt
+snapshots, torn vote-state meta (docs/DURABILITY.md) — driving the
+restart-recovery differential in ``tests/test_recovery.py``.
 """
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 FAULTS = ("heal", "loss", "partition", "isolate")
+
+#: The storage-fault vocabulary of :class:`StorageNemesis` (the host-plane
+#: crash/torn-write family, docs/DURABILITY.md).
+STORAGE_FAULTS = ("torn_tail", "partial_frame", "dropped_fsync",
+                  "corrupt_snapshot", "torn_meta")
 
 
 class Nemesis:
@@ -74,3 +88,173 @@ class Nemesis:
         hub = getattr(self._rg, "telemetry", None)
         if hub is not None:
             hub.flight.record("fault", self._rg.rounds, fault=fault)
+
+
+# ---------------------------------------------------------------------------
+# host plane: crash / torn-write faults over a server's storage directory
+# ---------------------------------------------------------------------------
+
+
+async def crash_server(server) -> None:
+    """Kill a ``RaftServer`` the way a SIGKILL would: stop its timers,
+    replication, and transport WITHOUT the graceful close path — no
+    ``log.close()``, no final msync/fsync, pending commit futures
+    abandoned.  What recovery then sees on disk is exactly what the
+    storage level's durability contract promised and nothing more; pair
+    with :class:`StorageNemesis` to tear what the crash left behind."""
+    server._closing = True
+    server._open = False  # Managed bookkeeping: a crashed server is closed
+    server._cancel_timers()
+    server._stop_replication()
+    for fut in server._commit_futures.values():
+        if not fut.done():
+            fut.cancel()
+    server._commit_futures.clear()
+    await server._server.close()
+    await server._client.close()
+    server._peer_connections.clear()
+    # NOTE: deliberately NOT server.log.close() — buffered/page-cache
+    # state stays wherever the fsync policy last left it
+
+
+class StorageNemesis:
+    """Crash/torn-write fault injection over one server's storage
+    directory (the host-plane sibling of :class:`Nemesis`): mutates the
+    on-disk artifacts a crashed process leaves behind — log segments,
+    snapshot files, the vote-state meta file — the way real torn writes,
+    reordered writeback, and lost page-cache flushes do.  Recovery must
+    shrug all of it off (tests/test_recovery.py)."""
+
+    def __init__(self, directory: str, seed: int = 0) -> None:
+        self.directory = directory
+        self._rng = np.random.default_rng(seed)
+        self.injected: list[tuple[str, str]] = []  # (fault, path)
+
+    # -- file discovery ----------------------------------------------------
+
+    def _files(self, *exts: str) -> list[str]:
+        out = []
+        for fname in sorted(os.listdir(self.directory)):
+            if fname.endswith(exts):
+                out.append(os.path.join(self.directory, fname))
+        return out
+
+    def newest_segment(self) -> str | None:
+        segs = self._files(".seg", ".mseg")
+        return segs[-1] if segs else None
+
+    def newest_snapshot(self) -> str | None:
+        snaps = self._files(".snap")
+        return snaps[-1] if snaps else None
+
+    def meta_file(self) -> str | None:
+        metas = self._files(".meta")
+        return metas[0] if metas else None
+
+    def _note(self, fault: str, path: str | None) -> str | None:
+        if path is not None:
+            self.injected.append((fault, path))
+        return path
+
+    # -- the fault family --------------------------------------------------
+
+    @staticmethod
+    def _written_end(path: str) -> int:
+        """End of the WRITTEN region: mapped segments are sparse with a
+        leading watermark (mutating their zero tail would be a no-op), so
+        the fault lands at ``header + watermark``; buffered segments are
+        written densely to their file size."""
+        if path.endswith(".mseg"):
+            with open(path, "rb") as f:
+                used = int.from_bytes(f.read(8), "little")
+            return 8 + used
+        return os.path.getsize(path)
+
+    def torn_tail(self, nbytes: int = 11) -> str | None:
+        """Chop ``nbytes`` off the newest log segment's written region: a
+        write that was mid-flight when the process died."""
+        path = self.newest_segment()
+        if path is None:
+            return None
+        # never truncate a mapped segment below its watermark header (an
+        # empty file cannot be mmapped back)
+        floor = 8 if path.endswith(".mseg") else 0
+        with open(path, "r+b") as f:
+            f.truncate(max(floor, self._written_end(path) - nbytes))
+        return self._note("torn_tail", path)
+
+    def partial_frame(self, nbytes: int = 24) -> str | None:
+        """Zero the last ``nbytes`` of the newest segment's written region
+        in place: frame header/payload pages that never hit the platter
+        even though the file length (or mmap watermark) says they did —
+        the reordered-writeback shape the seeded CRC framing exists for."""
+        path = self.newest_segment()
+        if path is None:
+            return None
+        end = self._written_end(path)
+        with open(path, "r+b") as f:
+            f.seek(max(0, end - nbytes))
+            f.write(b"\x00" * min(nbytes, end))
+        return self._note("partial_frame", path)
+
+    def dropped_fsync(self, frames: int = 1) -> str | None:
+        """Rewind the newest DISK segment by its last ``frames``
+        length-framed entries — a buffered write the kernel never flushed
+        (the ``fsync="never"`` failure mode).  Falls back to
+        :meth:`torn_tail` for MAPPED segments (page-cache granularity)."""
+        path = self.newest_segment()
+        if path is None:
+            return None
+        if path.endswith(".mseg"):
+            return self.torn_tail(64)
+        from ..io.buffer import BufferInput
+        with open(path, "rb") as f:
+            raw = f.read()
+        buf = BufferInput(raw)
+        ends = []
+        while buf.remaining > 0:
+            try:
+                buf.read_bytes()   # payload
+                buf.read_varint()  # trailing frame CRC
+            except EOFError:
+                break
+            ends.append(len(raw) - buf.remaining)
+        keep = ends[-1 - frames] if len(ends) > frames else 0
+        with open(path, "r+b") as f:
+            f.truncate(keep)
+        return self._note("dropped_fsync", path)
+
+    def corrupt_snapshot(self, nbytes: int = 16) -> str | None:
+        """Flip bytes inside the newest snapshot's payload so its CRC
+        frame check fails: recovery must skip it and fall back to an
+        older snapshot or full replay, never crash or restore garbage."""
+        path = self.newest_snapshot()
+        if path is None:
+            return None
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            # land inside the payload (past the 20-byte frame header)
+            start = int(self._rng.integers(20, max(21, size - nbytes)))
+            f.seek(start)
+            chunk = f.read(nbytes)
+            f.seek(start)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+        return self._note("corrupt_snapshot", path)
+
+    def torn_meta(self) -> str | None:
+        """Truncate the (term, voted_for) meta file mid-write: the torn
+        state a non-atomic writer leaves; boot must fall back to
+        zero-state instead of dying on a JSON parse error."""
+        path = self.meta_file()
+        if path is None:
+            return None
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(1, size // 2))
+        return self._note("torn_meta", path)
+
+    def inject(self, fault: str) -> str | None:
+        """Inject one named fault from :data:`STORAGE_FAULTS`."""
+        if fault not in STORAGE_FAULTS:
+            raise ValueError(f"unknown storage fault {fault!r}")
+        return getattr(self, fault)()

@@ -71,7 +71,11 @@ class Count(Message, Query):
 
 
 class KVStateMachine(StateMachine):
-    """Inline test machine exercising auto-registration, events, timers."""
+    """Inline test machine exercising auto-registration, events, timers,
+    and the crash-recovery plane's snapshot hooks (docs/DURABILITY.md):
+    pending TTL deadlines are part of the snapshot image and re-scheduled
+    on restore, so a recovered member expires keys at the same log time a
+    never-crashed member does."""
 
     def __init__(self) -> None:
         super().__init__()
@@ -79,6 +83,7 @@ class KVStateMachine(StateMachine):
         self.applied_ops = 0
         self.expired_sessions: list[int] = []
         self.closed_sessions: list[int] = []
+        self.ttl_deadlines: dict[Any, float] = {}  # key -> log-clock deadline
 
     def put(self, commit: Commit[Put]) -> Any:
         self.applied_ops += 1
@@ -92,13 +97,40 @@ class KVStateMachine(StateMachine):
         old = self.data.get(op.key)
         self.data[op.key] = op.value
         key = op.key
+        self.ttl_deadlines[key] = commit.time + op.ttl
 
         def expire() -> None:
             self.data.pop(key, None)
+            self.ttl_deadlines.pop(key, None)
             commit.clean()
 
         self.executor.schedule(op.ttl, expire)
         return old
+
+    # -- snapshot hooks ----------------------------------------------------
+
+    def snapshot_state(self) -> Any:
+        return {"data": dict(self.data),
+                "applied_ops": self.applied_ops,
+                "expired": list(self.expired_sessions),
+                "closed": list(self.closed_sessions),
+                "ttl": dict(self.ttl_deadlines)}
+
+    def restore_state(self, data: Any, sessions: dict) -> None:
+        self.data = dict(data["data"])
+        self.applied_ops = data["applied_ops"]
+        self.expired_sessions = list(data["expired"])
+        self.closed_sessions = list(data["closed"])
+        self.ttl_deadlines = dict(data["ttl"])
+        clock = self.executor.context.clock
+        for key, deadline in list(self.ttl_deadlines.items()):
+            def expire(_key=key) -> None:
+                # the creating commit is behind the snapshot boundary —
+                # its log entry is already released, nothing to clean()
+                self.data.pop(_key, None)
+                self.ttl_deadlines.pop(_key, None)
+
+            self.executor.schedule(max(0.0, deadline - clock), expire)
 
     def get(self, commit: Commit[Get]) -> Any:
         return self.data.get(commit.operation.key)
@@ -120,6 +152,48 @@ class KVStateMachine(StateMachine):
 
     def close(self, session: Any) -> None:
         self.closed_sessions.append(session.id)
+
+
+def _norm(obj: Any) -> Any:
+    """Order-insensitive canonical form for dict-shaped state (dict
+    insertion order is an implementation detail, not replicated state)."""
+    if isinstance(obj, dict):
+        return tuple(sorted((repr(k), _norm(v)) for k, v in obj.items()))
+    if isinstance(obj, (list, tuple)):
+        return tuple(_norm(x) for x in obj)
+    if isinstance(obj, set):
+        return tuple(sorted(repr(x) for x in obj))
+    return repr(obj)
+
+
+def server_fingerprint(server: RaftServer, from_index: int | None = None):
+    """Bit-comparable image of a server's replicated state — the
+    recovery differential's equality subject: serialized log entries
+    (from ``from_index``, so a prefix-truncated recovered member compares
+    over the shared range), the state machine's snapshot image, and the
+    session table's replicated halves."""
+    from copycat_tpu.io.serializer import Serializer
+
+    ser = Serializer()
+    log = server.log
+    start = log.first_index if from_index is None else max(
+        log.first_index, from_index)
+    entries = []
+    for i in range(start, log.last_index + 1):
+        e = log.get(i)
+        entries.append(None if e is None else ser.write(e))
+    machine = server.state_machine.snapshot_state()
+    sessions = sorted(
+        (sid, _norm(s.snapshot_dict())) for sid, s in server.sessions.items())
+    return {
+        "log_start": start,
+        "log_last": log.last_index,
+        "log": entries,
+        "machine": None if machine is NotImplemented else _norm(machine),
+        "sessions": sessions,
+        "last_applied": server.last_applied,
+        "clock": server.context.clock,
+    }
 
 
 _port_counter = [6000]
