@@ -1,0 +1,41 @@
+"""copycheck — project-native static analysis (docs/ANALYSIS.md).
+
+Seven AST-based rules, each grounded in a hazard this codebase has
+actually hit (flight-recorder findings, the PR 6 torn-write post-mortem,
+the ``utils/tasks.py`` weakref note):
+
+- ``loop-blocking`` — event-loop-blocking calls inside ``async def``
+  bodies (latency hazards for the repl/read pumps);
+- ``orphan-task`` — raw ``create_task``/``ensure_future`` outside
+  ``utils/tasks.spawn`` (the fire-and-forget weakref-GC hazard);
+- ``await-tear`` — an ``await`` between a read and an unguarded write of
+  protected Raft state in ``server/raft.py`` (the asyncio analogue of a
+  race detector);
+- ``knob-registry`` — every ``COPYCAT_*`` env read goes through
+  ``utils/knobs.py``; every knob named is registered;
+- ``metric-registry`` — every metric call site uses a name from the
+  ``docs/OBSERVABILITY.md`` catalog;
+- ``wire-schema`` — ``protocol/messages.py`` type ids unique and
+  ``_fields`` orders frozen against ``tests/golden/wire_schema.json``;
+- ``jit-purity`` — no ``time``/``random``/``os.environ``/host callbacks
+  reachable inside the jitted ``ops/`` step functions.
+
+Run with ``copycat-tpu lint`` (or ``python -m copycat_tpu.analysis``);
+``--strict`` is the CI gate. Findings are suppressed inline with
+``# copycheck: ignore[rule]`` or carried (with a justification) in
+``.copycheck-baseline.json``. Pure stdlib + AST: linting never imports
+jax or the modules it checks.
+"""
+
+from .engine import LintContext, run_lint  # noqa: F401
+from .findings import Finding  # noqa: F401
+
+ALL_RULES = (
+    "loop-blocking",
+    "orphan-task",
+    "await-tear",
+    "knob-registry",
+    "metric-registry",
+    "wire-schema",
+    "jit-purity",
+)

@@ -1,0 +1,9 @@
+"""``python -m copycat_tpu.analysis`` — same surface as ``copycat-tpu
+lint`` (jax-free; see docs/ANALYSIS.md)."""
+
+import sys
+
+from .engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

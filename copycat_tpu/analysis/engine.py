@@ -1,0 +1,353 @@
+"""The copycheck engine: discovery, caching, suppressions, baseline, CLI.
+
+Pure stdlib — parsing is ``ast``, project context (knob registry, metric
+catalog, wire golden) is read as *text*, never imported, so ``copycat-tpu
+lint`` runs in a venv with no jax and touches nothing it checks.
+
+Per-file caching: findings are memoized in ``.copycheck-cache.json``
+keyed by the file's content digest plus a config digest covering the
+analysis package itself and the cross-file inputs (catalog, golden,
+knob registry). Editing any rule or registry invalidates everything;
+editing one source file re-lints just that file. The cache stores RAW
+findings — suppressions and the baseline are applied after lookup, so
+editing the baseline never needs a re-lint.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+
+from .findings import Baseline, Finding, is_suppressed, scan_suppressions
+from .rules_asyncio import check_loop_blocking, check_orphan_task
+from .rules_await_tear import check_await_tear
+from .rules_jit import check_jit_purity, collect_jit_roots
+from .rules_registries import (
+    check_knob_registry,
+    check_metric_registry,
+    parse_knob_registry,
+    parse_metric_catalog,
+)
+from .rules_wire import GOLDEN_PATH, check_wire_schema, render_golden
+
+CACHE_FILE = ".copycheck-cache.json"
+BASELINE_FILE = ".copycheck-baseline.json"
+
+#: Scanned by default (repo-root-relative). Tests are exercised by
+#: pytest, not linted — their fixtures *seed* violations on purpose.
+DEFAULT_ROOTS = ("copycat_tpu", "bench.py", "__graft_entry__.py", "examples")
+
+
+
+def _repo_root() -> str:
+    # copycat_tpu/analysis/engine.py -> repo root two levels up from the
+    # package directory; fall back to cwd for installed trees.
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if os.path.isdir(os.path.join(here, "copycat_tpu")):
+        return here
+    return os.getcwd()
+
+
+def _read(path: str) -> str | None:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+@dataclass
+class LintContext:
+    root: str
+    knob_names: set[str] = field(default_factory=set)
+    metric_catalog: dict[str, set[str]] | None = None
+    wire_golden: dict | None = None
+    jit_roots: set[str] = field(default_factory=set)
+    config_digest: str = ""
+
+    @classmethod
+    def build(cls, root: str, trees: dict[str, ast.Module]) -> "LintContext":
+        ctx = cls(root=root)
+        knobs_src = _read(os.path.join(root, "copycat_tpu", "utils",
+                                       "knobs.py"))
+        if knobs_src:
+            ctx.knob_names = parse_knob_registry(knobs_src)
+        observability = _read(os.path.join(root, "docs", "OBSERVABILITY.md"))
+        if observability:
+            ctx.metric_catalog = parse_metric_catalog(observability)
+        golden_src = _read(os.path.join(root, GOLDEN_PATH))
+        if golden_src:
+            try:
+                ctx.wire_golden = json.loads(golden_src)
+            except ValueError:
+                ctx.wire_golden = None
+        ctx.jit_roots = collect_jit_roots(trees)
+        digest = hashlib.sha256()
+        for part in (knobs_src or "", observability or "", golden_src or "",
+                     "|".join(sorted(ctx.jit_roots))):
+            digest.update(part.encode())
+            digest.update(b"\x00")
+        for mod in sorted(os.listdir(os.path.dirname(__file__))):
+            if mod.endswith(".py"):
+                digest.update(
+                    _read(os.path.join(os.path.dirname(__file__),
+                                       mod)).encode())
+        ctx.config_digest = digest.hexdigest()
+        return ctx
+
+
+def lint_file(path: str, source: str, tree: ast.Module,
+              ctx: LintContext) -> list[Finding]:
+    """All raw findings for one file (suppressions/baseline NOT applied)."""
+    findings: list[Finding] = []
+    findings += check_loop_blocking(tree, path)
+    findings += check_orphan_task(tree, path)
+    findings += check_await_tear(tree, path)
+    findings += check_knob_registry(tree, path, ctx.knob_names)
+    # metric-registry is package-scoped: benches/examples at the repo
+    # root stage env for servers they build, not metric planes
+    if (ctx.metric_catalog is not None
+            and path.startswith("copycat_tpu/")):
+        findings += check_metric_registry(tree, path, ctx.metric_catalog)
+    findings += check_wire_schema(tree, path, ctx.wire_golden)
+    findings += check_jit_purity(tree, path, ctx.jit_roots)
+    return findings
+
+
+def discover(root: str, paths: list[str] | None = None) -> list[str]:
+    """Repo-relative .py files to lint, sorted."""
+    roots = paths or [os.path.join(root, p) for p in DEFAULT_ROOTS]
+    out: set[str] = set()
+    for entry in roots:
+        if os.path.isfile(entry) and entry.endswith(".py"):
+            out.add(os.path.relpath(entry, root))
+        elif os.path.isdir(entry):
+            for dirpath, dirnames, filenames in os.walk(entry):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for name in filenames:
+                    if name.endswith(".py"):
+                        out.add(os.path.relpath(
+                            os.path.join(dirpath, name), root))
+    return sorted(p.replace(os.sep, "/") for p in out)
+
+
+class _Cache:
+    def __init__(self, path: str, enabled: bool) -> None:
+        self.path = path
+        self.enabled = enabled
+        self.dirty = False
+        self.data: dict = {}
+        if enabled:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    self.data = json.load(f).get("files", {})
+            except (OSError, ValueError):
+                self.data = {}
+
+    def get(self, rel: str, digest: str, config: str) -> list[Finding] | None:
+        entry = self.data.get(rel)
+        if (not self.enabled or entry is None or entry.get("digest") != digest
+                or entry.get("config") != config):
+            return None
+        return [Finding(**f) for f in entry.get("findings", [])]
+
+    def put(self, rel: str, digest: str, config: str,
+            findings: list[Finding]) -> None:
+        if not self.enabled:
+            return
+        self.data[rel] = {"digest": digest, "config": config,
+                          "findings": [f.to_json() for f in findings]}
+        self.dirty = True
+
+    def save(self) -> None:
+        if not (self.enabled and self.dirty):
+            return
+        try:
+            with open(self.path, "w", encoding="utf-8") as f:
+                json.dump({"version": 1, "files": self.data}, f)
+        except OSError:
+            pass  # a read-only checkout just goes uncached
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding]          # actionable (not suppressed/baselined)
+    baselined: list[Finding]
+    suppressed: list[Finding]
+    stale_baseline: list[tuple]
+    files: int = 0
+    parse_errors: list[str] = field(default_factory=list)
+
+
+def run_lint(root: str | None = None, paths: list[str] | None = None,
+             baseline_path: str | None = None,
+             use_cache: bool = True) -> LintResult:
+    root = root or _repo_root()
+    rels = discover(root, paths)
+    sources: dict[str, str] = {}
+    trees: dict[str, ast.Module] = {}
+    parse_errors: list[str] = []
+    for rel in rels:
+        src = _read(os.path.join(root, rel))
+        if src is None:
+            continue
+        try:
+            trees[rel] = ast.parse(src)
+            sources[rel] = src
+        except SyntaxError as e:
+            parse_errors.append(f"{rel}: {e}")
+    ctx = LintContext.build(root, trees)
+    cache = _Cache(os.path.join(root, CACHE_FILE), use_cache)
+    raw: list[Finding] = []
+    for rel, tree in trees.items():
+        digest = hashlib.sha256(sources[rel].encode()).hexdigest()
+        cached = cache.get(rel, digest, ctx.config_digest)
+        if cached is None:
+            cached = lint_file(rel, sources[rel], tree, ctx)
+            cache.put(rel, digest, ctx.config_digest, cached)
+        raw.extend(cached)
+    cache.save()
+
+    baseline = Baseline.load(
+        baseline_path or os.path.join(root, BASELINE_FILE))
+    actionable: list[Finding] = []
+    suppressed: list[Finding] = []
+    baselined: list[Finding] = []
+    suppressions_by_path: dict[str, dict[int, set[str]]] = {}
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.rule)):
+        file_suppressions = suppressions_by_path.get(f.path)
+        if file_suppressions is None:
+            file_suppressions = scan_suppressions(sources.get(f.path, ""))
+            suppressions_by_path[f.path] = file_suppressions
+        if is_suppressed(f, file_suppressions):
+            suppressed.append(f)
+        elif baseline.match(f):
+            baselined.append(f)
+        else:
+            actionable.append(f)
+    return LintResult(
+        findings=actionable, baselined=baselined, suppressed=suppressed,
+        stale_baseline=baseline.stale(baselined + actionable),
+        files=len(trees), parse_errors=parse_errors)
+
+
+def write_baseline(result: LintResult, root: str | None = None,
+                   baseline_path: str | None = None) -> str:
+    root = root or _repo_root()
+    path = baseline_path or os.path.join(root, BASELINE_FILE)
+    existing = Baseline.load(path)
+    merged = Baseline()
+    for f in result.baselined:
+        merged.entries[f.identity()] = existing.entries.get(f.identity(), "")
+    for f in result.findings:
+        merged.entries[f.identity()] = ""
+    merged.save(path)
+    return path
+
+
+def update_wire_golden(root: str | None = None) -> str:
+    root = root or _repo_root()
+    src = _read(os.path.join(root, "copycat_tpu", "protocol", "messages.py"))
+    if src is None:
+        raise SystemExit("copycat_tpu/protocol/messages.py not found")
+    golden = render_golden(ast.parse(src))
+    path = os.path.join(root, GOLDEN_PATH)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(golden)
+    return path
+
+
+def render_text(result: LintResult, strict: bool) -> str:
+    lines: list[str] = []
+    for f in result.findings:
+        lines.append(f.render())
+    for err in result.parse_errors:
+        lines.append(f"PARSE ERROR: {err}")
+    if result.stale_baseline:
+        lines.append("")
+        lines.append("stale baseline entries (fixed findings — prune them "
+                     "from .copycheck-baseline.json):")
+        for rule, path, symbol, message in result.stale_baseline:
+            lines.append(f"  {path} [{symbol}] {rule}: {message[:60]}")
+    failed = bool(result.findings or result.parse_errors
+                  or (strict and result.stale_baseline))
+    status = "FAIL" if failed else "ok"
+    lines.append("")
+    lines.append(
+        f"copycheck: {status} — {result.files} files, "
+        f"{len(result.findings)} finding(s), "
+        f"{len(result.baselined)} baselined, "
+        f"{len(result.suppressed)} suppressed"
+        + (f", {len(result.stale_baseline)} stale baseline entr(ies)"
+           if result.stale_baseline else ""))
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult) -> str:
+    return json.dumps({
+        "findings": [f.to_json() for f in result.findings],
+        "baselined": [f.to_json() for f in result.baselined],
+        "suppressed": [f.to_json() for f in result.suppressed],
+        "stale_baseline": [list(k) for k in result.stale_baseline],
+        "files": result.files,
+        "parse_errors": result.parse_errors,
+    }, indent=2)
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="copycat-tpu lint",
+        description="copycheck: project-native static analysis "
+                    "(docs/ANALYSIS.md)")
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs to lint (default: the product "
+                             "tree)")
+    parser.add_argument("--strict", action="store_true",
+                        help="exit 1 on any unsuppressed, unbaselined "
+                             "finding AND on stale baseline entries (the "
+                             "CI gate)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="ignore + don't write .copycheck-cache.json")
+    parser.add_argument("--baseline", default=None, metavar="PATH",
+                        help="baseline file (default "
+                             ".copycheck-baseline.json at the repo root)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="accept current findings into the baseline "
+                             "(fill in the justifications!)")
+    parser.add_argument("--update-golden", action="store_true",
+                        help="regenerate tests/golden/wire_schema.json "
+                             "from protocol/messages.py")
+    args = parser.parse_args(argv)
+
+    if args.update_golden:
+        path = update_wire_golden()
+        print(f"wire-schema golden regenerated: {path}")
+        return 0
+
+    result = run_lint(paths=args.paths or None,
+                      baseline_path=args.baseline,
+                      use_cache=not args.no_cache)
+    if args.write_baseline:
+        path = write_baseline(result, baseline_path=args.baseline)
+        print(f"baseline written: {path} "
+              f"({len(result.findings) + len(result.baselined)} entries)")
+        return 0
+    if args.format == "json":
+        print(render_json(result))
+    else:
+        print(render_text(result, args.strict))
+    if result.findings or result.parse_errors:
+        return 1
+    if args.strict and result.stale_baseline:
+        return 1
+    return 0

@@ -1,0 +1,108 @@
+"""asyncio hygiene rules: ``loop-blocking`` and ``orphan-task``.
+
+``loop-blocking``: a curated blocklist of calls that stall the event
+loop when made from an ``async def`` body. One stalled turn holds every
+staged read window and replication ack behind it — the latency hazard
+is measured, not theoretical (the read pump coalesces per event-loop
+turn, PERF.md round 9). Nested *sync* defs are skipped: blocking there
+is judged at the call site.
+
+``orphan-task``: ``loop.create_task`` / ``asyncio.ensure_future``
+anywhere but ``utils/tasks.py``. The loop holds only a weak reference to
+tasks — a fire-and-forget task can be garbage-collected mid-flight, and
+an unobserved exception vanishes. ``utils/tasks.spawn`` is the one
+blessed spawn point (strong ref until done + error logging), so every
+background task in the tree shares its lifecycle guarantees.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astutil import (
+    body_nodes_excluding_nested_defs,
+    dotted_name,
+    enclosing_symbol,
+    iter_async_functions,
+)
+from .findings import Finding
+
+# Call chains that block the calling thread. Receiver-qualified names
+# match exactly ("time.sleep" does not match "asyncio.time.sleep" — the
+# chain is rendered from the AST, so aliasing hides from us; the rule is
+# a tripwire, not a sandbox).
+BLOCKING_CALLS = {
+    "time.sleep": "blocks the event loop; use `await asyncio.sleep(...)`",
+    "os.fsync": "synchronous disk flush on the loop thread",
+    "os.fdatasync": "synchronous disk flush on the loop thread",
+    "os.replace": "synchronous rename on the loop thread",
+    "subprocess.run": "blocking subprocess wait",
+    "subprocess.call": "blocking subprocess wait",
+    "subprocess.check_call": "blocking subprocess wait",
+    "subprocess.check_output": "blocking subprocess wait",
+    "shutil.rmtree": "synchronous recursive delete on the loop thread",
+    "shutil.copyfile": "synchronous file copy on the loop thread",
+    "shutil.copytree": "synchronous tree copy on the loop thread",
+    "jax.device_get": "synchronous device fetch on the loop thread",
+    "jax.block_until_ready": "synchronous device sync on the loop thread",
+}
+
+# Method names that block regardless of receiver.
+BLOCKING_METHODS = {
+    "block_until_ready": "synchronous device sync on the loop thread",
+}
+
+# The builtin ``open``: sync file I/O from a coroutine.
+BLOCKING_BUILTINS = {
+    "open": "synchronous file open/IO on the loop thread",
+}
+
+SPAWN_CALLS = ("create_task", "ensure_future")
+
+
+def check_loop_blocking(tree: ast.Module, path: str) -> list[Finding]:
+    findings: list[Finding] = []
+    for fn, qual in iter_async_functions(tree):
+        for node in body_nodes_excluding_nested_defs(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            why = None
+            name = dotted_name(node.func)
+            if name in BLOCKING_CALLS:
+                why = f"`{name}(...)` — {BLOCKING_CALLS[name]}"
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in BLOCKING_METHODS):
+                why = (f"`.{node.func.attr}(...)` — "
+                       f"{BLOCKING_METHODS[node.func.attr]}")
+            elif (isinstance(node.func, ast.Name)
+                  and node.func.id in BLOCKING_BUILTINS):
+                why = (f"`{node.func.id}(...)` — "
+                       f"{BLOCKING_BUILTINS[node.func.id]}")
+            if why:
+                findings.append(Finding(
+                    rule="loop-blocking", path=path, line=node.lineno,
+                    message=f"blocking call in async def: {why}",
+                    symbol=qual))
+    return findings
+
+
+def check_orphan_task(tree: ast.Module, path: str) -> list[Finding]:
+    if path.endswith("utils/tasks.py"):
+        return []  # the blessed spawn point itself
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        is_spawn = (
+            (isinstance(func, ast.Attribute) and func.attr in SPAWN_CALLS)
+            or (isinstance(func, ast.Name) and func.id in SPAWN_CALLS))
+        if not is_spawn:
+            continue
+        findings.append(Finding(
+            rule="orphan-task", path=path, line=node.lineno,
+            message=("raw task spawn — the loop keeps only a weak ref and "
+                     "exceptions vanish; route through `utils/tasks.spawn` "
+                     "(returns the task, logs failures, holds a strong ref)"),
+            symbol=enclosing_symbol(tree, node.lineno)))
+    return findings

@@ -1,0 +1,165 @@
+"""``await-tear``: unguarded protected-state writes after an ``await``.
+
+The asyncio analogue of a race detector, specialized to the Raft
+server's transition methods (``server/raft.py``). Single-threaded
+asyncio removes data races but not *interleavings*: every ``await`` is a
+point where another coroutine can run a whole election, append, or
+snapshot install. A method that (1) reads protected Raft state, (2)
+awaits, then (3) writes that state based on the stale read has torn the
+transition — exactly the bug class "On the parallels between Paxos and
+Raft" catalogs as quorum-era confusion, and the one the flight recorder
+only catches after the fact, on device.
+
+Protected state: ``self.term``, ``self.voted_for``,
+``self.commit_index``, ``self.last_applied``, and the log tail (writes
+via ``self.log.append/append_replicated_block/truncate/truncate_prefix/
+reset_to/compact``, reads via any other ``self.log.*`` use).
+
+The blessed pattern re-validates after the await — the epoch guard the
+election path already uses::
+
+    term = self.term
+    responses = await gather(...)          # interleaving point
+    if self.role != CANDIDATE or self.term != term:
+        return                             # epoch guard re-reads state
+    self.commit_index = ...                # now safe
+
+Concretely: a write to a protected field is flagged when (a) at least
+one ``await`` precedes it in the method, (b) the same field was read
+*before* that await (the decision input), and (c) no ``if``/``while``
+test between the last preceding await and the write re-reads that field
+or ``self.role``. The rule is lexical (source order, not CFG paths) —
+deliberately so: a guard that only covers one branch still re-reads the
+state, and a method complex enough to defeat the lexical view belongs in
+the baseline with a justification, not silently passed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astutil import iter_async_functions
+from .findings import Finding
+
+PROTECTED_FIELDS = ("term", "voted_for", "commit_index", "last_applied")
+LOG_WRITE_METHODS = ("append", "append_replicated_block", "truncate",
+                     "truncate_prefix", "reset_to", "compact", "set_commit")
+GUARD_FIELDS = PROTECTED_FIELDS + ("role", "log")
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``self.X`` -> ``X`` for protected fields; ``self.log`` -> 'log'."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _Events(ast.NodeVisitor):
+    """Collect (line-ordered) reads, writes, awaits and guard tests for
+    one async function body, without descending into nested defs."""
+
+    def __init__(self) -> None:
+        self.reads: list[tuple[int, str]] = []
+        self.writes: list[tuple[int, str]] = []
+        self.awaits: list[int] = []
+        self.guards: list[tuple[int, str]] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass  # nested sync def: its own context
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        pass  # nested coroutine: analyzed on its own
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass
+
+    def visit_Await(self, node: ast.Await) -> None:
+        self.awaits.append(node.lineno)
+        self.generic_visit(node)
+
+    def _note_test(self, test: ast.AST) -> None:
+        for sub in ast.walk(test):
+            attr = _self_attr(sub)
+            if attr in PROTECTED_FIELDS or attr == "role":
+                self.guards.append((test.lineno, attr))
+            elif (isinstance(sub, ast.Attribute)
+                  and _self_attr(sub.value) == "log"):
+                self.guards.append((test.lineno, "log"))
+
+    def visit_If(self, node: ast.If) -> None:
+        self._note_test(node.test)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._note_test(node.test)
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._note_test(node.test)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr in PROTECTED_FIELDS:
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                self.writes.append((node.lineno, attr))
+            else:
+                self.reads.append((node.lineno, attr))
+        elif (_self_attr(node.value) == "log"
+              and isinstance(node.ctx, ast.Load)):
+            # self.log.last_index / .term_at — a log-tail read (write
+            # methods are classified in visit_Call; an extra read note
+            # on the same line is harmless)
+            self.reads.append((node.lineno, "log"))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # self.log.append(...) and friends: log-tail writes; any other
+        # self.log.X(...) counts as a log read (term_at, last_index...).
+        func = node.func
+        if (isinstance(func, ast.Attribute)
+                and _self_attr(func.value) == "log"):
+            if func.attr in LOG_WRITE_METHODS:
+                self.writes.append((node.lineno, "log"))
+            else:
+                self.reads.append((node.lineno, "log"))
+        self.generic_visit(node)
+
+
+def check_await_tear(tree: ast.Module, path: str) -> list[Finding]:
+    # Specialized to the Raft server (fixture tests hand in any path
+    # whose basename mentions raft).
+    if "raft" not in path.rsplit("/", 1)[-1]:
+        return []
+    findings: list[Finding] = []
+    for fn, qual in iter_async_functions(tree):
+        events = _Events()
+        for stmt in fn.body:
+            events.visit(stmt)
+        if not events.awaits:
+            continue
+        for wline, field in events.writes:
+            awaits_before = [a for a in events.awaits if a < wline]
+            if not awaits_before:
+                continue
+            last_await = max(awaits_before)
+            stale_read = any(r < last_await and f == field
+                             for r, f in events.reads)
+            if not stale_read:
+                continue
+            guarded = any(last_await < g <= wline
+                          and gf in (field, "role")
+                          for g, gf in events.guards)
+            if guarded:
+                continue
+            findings.append(Finding(
+                rule="await-tear", path=path, line=wline,
+                message=(f"write to protected `self.{field}` after an "
+                         f"await with no re-validation of `{field}`/"
+                         f"`role` between the interleaving point and the "
+                         f"write — re-check the epoch before committing "
+                         f"the transition"),
+                symbol=qual))
+    return findings

@@ -58,6 +58,7 @@ from functools import partial
 
 import jax
 
+from .utils import knobs
 from .utils.platform import honor_jax_platforms_env
 
 honor_jax_platforms_env()
@@ -97,23 +98,23 @@ RESOURCE_CONFIGS = {
     "mixed": ResourceConfig(multimap_slots=0, topic_slots=0),
 }
 
-SCENARIO = os.environ.get("COPYCAT_BENCH_SCENARIO", "counter")
-GROUPS = int(os.environ.get(
-    "COPYCAT_BENCH_GROUPS", "1000" if SCENARIO == "election" else "10000"))
-PEERS = int(os.environ.get("COPYCAT_BENCH_PEERS", "3"))
+SCENARIO = knobs.get_str("COPYCAT_BENCH_SCENARIO")
+GROUPS = knobs.get_int(
+    "COPYCAT_BENCH_GROUPS", default=1000 if SCENARIO == "election" else 10000)
+PEERS = knobs.get_int("COPYCAT_BENCH_PEERS")
 # The mixed config is [G,P,L]-bandwidth-bound: L=32 measured +11%
 # throughput and p50 106->31 ms vs L=64 at 100k x 5 (PERF.md round-3
 # continuation); the ring only needs to cover in-flight depth (S=16 with
 # backpressure). Other configs are smaller and keep the roomier default.
-LOG_SLOTS = int(os.environ.get("COPYCAT_BENCH_LOG_SLOTS",
-                               "32" if SCENARIO == "mixed" else "64"))
-ROUNDS = int(os.environ.get("COPYCAT_BENCH_ROUNDS", "200"))
+LOG_SLOTS = knobs.get_int("COPYCAT_BENCH_LOG_SLOTS",
+                          default=32 if SCENARIO == "mixed" else 64)
+ROUNDS = knobs.get_int("COPYCAT_BENCH_ROUNDS")
 # Best-of-N: 5 reps (~0.3s each) buys insurance against tunnel/dispatch
 # jitter on the recorded number — observed session-to-session swings of
 # ±30% on otherwise-identical code come from the environment, not the
 # step (BENCH_SCENARIOS.md note ¹).
-REPEATS = int(os.environ.get("COPYCAT_BENCH_REPEATS", "5"))
-SUBMIT_SLOTS = int(os.environ.get("COPYCAT_BENCH_SUBMIT_SLOTS", "16"))
+REPEATS = knobs.get_int("COPYCAT_BENCH_REPEATS")
+SUBMIT_SLOTS = knobs.get_int("COPYCAT_BENCH_SUBMIT_SLOTS")
 NORTH_STAR_OPS = 1_000_000.0
 # Default the Pallas quorum-tally kernel ON for TPU: measured at parity
 # with the jnp path after the one-hot rewrite (PERF.md §Pallas A/B — the
@@ -122,7 +123,7 @@ NORTH_STAR_OPS = 1_000_000.0
 # test-only). Resolved LAZILY: jax.default_backend() initializes the
 # backend, which must not happen at import time — _require_devices()
 # gates it with a timeout first (a dead tunnel hangs enumeration).
-_PALLAS_ENV = os.environ.get("COPYCAT_BENCH_PALLAS")
+_PALLAS_ENV = knobs.get_raw("COPYCAT_BENCH_PALLAS")
 
 
 def use_pallas() -> bool:
@@ -143,14 +144,15 @@ def use_pallas() -> bool:
 _full = str(max(4, SUBMIT_SLOTS))  # = applies_per_round, never a throttle
 _default_budgets = {"mixed": "4,6,4,6,4,4,4,4",
                     "lock": ",".join([_full] * 8)}.get(SCENARIO, "")
-_budgets_env = os.environ.get("COPYCAT_BENCH_POOL_BUDGETS", _default_budgets)
+_budgets_env = knobs.get_str("COPYCAT_BENCH_POOL_BUDGETS",
+                             default=_default_budgets)
 POOL_BUDGETS = (tuple(int(x) for x in _budgets_env.split(","))
                 if _budgets_env else None)
 
 # Set to a directory to capture an XLA profiler trace of the first timed
 # repetition (open in TensorBoard/XProf, or summarize with
 # copycat_tpu.utils.profiling.summarize_trace).
-PROFILE_DIR = os.environ.get("COPYCAT_BENCH_PROFILE", "")
+PROFILE_DIR = knobs.get_str("COPYCAT_BENCH_PROFILE")
 
 # COPYCAT_BENCH_TELEMETRY=1: compile the round-8 device telemetry block
 # into the measured step (Config(telemetry=True)) — the A/B knob behind
@@ -159,7 +161,7 @@ PROFILE_DIR = os.environ.get("COPYCAT_BENCH_PROFILE", "")
 # would be dead-code-eliminated and the A/B would measure nothing) and
 # reports the totals; run_host/run_session surface the engine's
 # device.* family in the --metrics-json artifact.
-TELEMETRY = os.environ.get("COPYCAT_BENCH_TELEMETRY", "0") == "1"
+TELEMETRY = knobs.get_bool("COPYCAT_BENCH_TELEMETRY")
 
 
 def log(msg: str) -> None:
@@ -335,10 +337,10 @@ def run_throughput(scenario: str) -> dict:
     # range is too narrow to break vote splits and elections thrash.
     # Partition-only nemesis keeps short timers safe here; lossy
     # environments (the verdict runner) keep the roomier engine default.
-    t_min = int(os.environ.get("COPYCAT_BENCH_TIMER_MIN",
-                               "2" if scenario == "mixed" else "4"))
-    t_max = int(os.environ.get("COPYCAT_BENCH_TIMER_MAX",
-                               "4" if scenario == "mixed" else "9"))
+    t_min = knobs.get_int("COPYCAT_BENCH_TIMER_MIN",
+                          default=2 if scenario == "mixed" else 4)
+    t_max = knobs.get_int("COPYCAT_BENCH_TIMER_MAX",
+                          default=4 if scenario == "mixed" else 9)
     config = Config(use_pallas=use_pallas(),
                     append_window=max(4, SUBMIT_SLOTS),
                     applies_per_round=max(4, SUBMIT_SLOTS),
@@ -506,7 +508,7 @@ def run_host() -> dict:
     side."""
     from .models import BulkDriver, RaftGroups
 
-    mode = os.environ.get("COPYCAT_BENCH_HOST_MODE", "deep")
+    mode = knobs.get_str("COPYCAT_BENCH_HOST_MODE")
     if mode not in ("deep", "deepscan", "bulk", "queued"):
         raise SystemExit(
             f"COPYCAT_BENCH_HOST_MODE={mode!r}: deep|deepscan|bulk|queued")
@@ -520,9 +522,9 @@ def run_host() -> dict:
                                   telemetry=TELEMETRY,
                                   monotone_tag_accept=(
                                       mode in ("deep", "deepscan"))))
-    per_group = int(os.environ.get(
+    per_group = knobs.get_int(
         "COPYCAT_BENCH_HOST_BURST",
-        str(SUBMIT_SLOTS * (8 if mode != "queued" else 1))))
+        default=SUBMIT_SLOTS * (8 if mode != "queued" else 1))
     log(f"bench[host:{mode}]: G={GROUPS} P={PEERS} {per_group} "
         f"ops/group/burst; device={jax.devices()[0].platform}")
     rg.wait_for_leaders()
@@ -586,7 +588,7 @@ def run_session() -> dict:
     plane; round-5 target ≥100k committed ops/s on one chip."""
     from .models import BulkSessionClient, RaftGroups
 
-    n_sessions = int(os.environ.get("COPYCAT_BENCH_SESSIONS", "16"))
+    n_sessions = knobs.get_int("COPYCAT_BENCH_SESSIONS")
     rg = RaftGroups(GROUPS, PEERS, log_slots=LOG_SLOTS,
                     submit_slots=SUBMIT_SLOTS,
                     config=Config(use_pallas=use_pallas(),
@@ -596,14 +598,14 @@ def run_session() -> dict:
                                   resource=RESOURCE_CONFIGS["counter"],
                                   telemetry=TELEMETRY,
                                   monotone_tag_accept=True))
-    per_group = int(os.environ.get("COPYCAT_BENCH_HOST_BURST",
-                                   str(SUBMIT_SLOTS * 8)))
+    per_group = knobs.get_int("COPYCAT_BENCH_HOST_BURST",
+                              default=SUBMIT_SLOTS * 8)
     log(f"bench[session]: G={GROUPS} P={PEERS} {n_sessions} sessions, "
         f"{per_group} ops/group/burst; "
         f"device={jax.devices()[0].platform}")
     rg.wait_for_leaders()
     client = BulkSessionClient(
-        rg, deep_scan=os.environ.get("COPYCAT_BENCH_SESSION_SCAN") == "1")
+        rg, deep_scan=knobs.get_bool("COPYCAT_BENCH_SESSION_SCAN"))
     sessions = [client.open_session() for _ in range(n_sessions)]
     # each session owns an equal slice of the groups (disjoint groups
     # keep per-session FIFO independent of scheduling order)
@@ -676,8 +678,8 @@ def run_spi() -> dict:
     from .manager.atomix import AtomixClient, AtomixServer
     from .manager.device_executor import DeviceEngineConfig
 
-    instances = int(os.environ.get("COPYCAT_BENCH_SPI_INSTANCES", "1000"))
-    bursts = int(os.environ.get("COPYCAT_BENCH_SPI_BURSTS", "5"))
+    instances = knobs.get_int("COPYCAT_BENCH_SPI_INSTANCES")
+    bursts = knobs.get_int("COPYCAT_BENCH_SPI_BURSTS")
     # int (default): device-resident counters — the device fast path.
     # str: DistributedMap puts with STRING values, which every device-
     # backed map refuses onto int32 lanes and takes through the host
@@ -685,7 +687,7 @@ def run_spi() -> dict:
     # cliff (VERDICT r4 missing #4; reference DistributedMap.java:54
     # takes arbitrary K/V, so the cliff must be a number, not a
     # surprise).
-    payload = os.environ.get("COPYCAT_BENCH_SPI_PAYLOAD", "int")
+    payload = knobs.get_str("COPYCAT_BENCH_SPI_PAYLOAD")
     if payload not in ("int", "str"):
         raise SystemExit(f"COPYCAT_BENCH_SPI_PAYLOAD={payload!r}: int|str")
     # Engine pool provisioning (DeviceEngineConfig.resource): the counter
@@ -694,8 +696,8 @@ def run_spi() -> dict:
     # the loaded round 9.3 -> 5.1 ms at capacity 1024 on CPU. The str
     # (shadow-cliff) scenario needs the map pool live, so it keeps all
     # pools; override with COPYCAT_BENCH_SPI_POOLS=counters|all.
-    pools = os.environ.get("COPYCAT_BENCH_SPI_POOLS",
-                           "counters" if payload == "int" else "all")
+    pools = knobs.get_str("COPYCAT_BENCH_SPI_POOLS",
+                          default="counters" if payload == "int" else "all")
     if pools not in ("counters", "all"):
         raise SystemExit(f"COPYCAT_BENCH_SPI_POOLS={pools!r}: counters|all")
     engine_pools = (ResourceConfig.counters_only() if pools == "counters"
@@ -705,18 +707,18 @@ def run_spi() -> dict:
     # Depth 2 overlaps the client/submit stack with the window pump
     # (~+40% measured on CPU); deeper convoys fragment the window into
     # more partial pump cycles and lose it again.
-    waves = int(os.environ.get("COPYCAT_BENCH_SPI_WAVES", "1"))
+    waves = knobs.get_int("COPYCAT_BENCH_SPI_WAVES")
     # local (in-memory, default) | tcp (asyncio sockets) | native (C++
     # epoll + C codec): same wire format, so the knob isolates the IO
     # stack's share of the client-visible number
-    transport_kind = os.environ.get("COPYCAT_BENCH_SPI_TRANSPORT", "local")
+    transport_kind = knobs.get_str("COPYCAT_BENCH_SPI_TRANSPORT")
     capacity = 1 << max(4, (instances - 1).bit_length())  # pow2 >= instances
     # Engine ring: the spi steady state keeps ≤1 in-flight entry per
     # group (one public op per instance per burst), so the 32-slot ring
     # round 5 ran was 2x headroom paid in one-hot pass width every
     # round; 16 measured -0.3 ms/loaded round at G=1024 with identical
     # commit behavior. Override for deeper per-group pipelining.
-    log_slots = int(os.environ.get("COPYCAT_BENCH_SPI_LOG_SLOTS", "16"))
+    log_slots = knobs.get_int("COPYCAT_BENCH_SPI_LOG_SLOTS")
     registry = LocalServerRegistry()  # shared by both ends in local mode
 
     def make_transport():
@@ -858,11 +860,10 @@ def run_readmix() -> dict:
     from .manager.device_executor import DeviceEngineConfig
     from .resource.consistency import Consistency
 
-    instances = int(os.environ.get("COPYCAT_BENCH_SPI_INSTANCES", "1000"))
-    bursts = int(os.environ.get("COPYCAT_BENCH_SPI_BURSTS", "5"))
-    reads_per_write = int(os.environ.get("COPYCAT_BENCH_READMIX_READS",
-                                         "9"))
-    level = os.environ.get("COPYCAT_BENCH_READMIX_LEVEL", "atomic")
+    instances = knobs.get_int("COPYCAT_BENCH_SPI_INSTANCES")
+    bursts = knobs.get_int("COPYCAT_BENCH_SPI_BURSTS")
+    reads_per_write = knobs.get_int("COPYCAT_BENCH_READMIX_READS")
+    level = knobs.get_str("COPYCAT_BENCH_READMIX_LEVEL")
     facade_level = {"atomic": Consistency.ATOMIC,
                     "sequential": Consistency.SEQUENTIAL,
                     "none": Consistency.NONE}.get(level)
@@ -870,9 +871,9 @@ def run_readmix() -> dict:
         raise SystemExit(
             f"COPYCAT_BENCH_READMIX_LEVEL={level!r}: "
             "atomic|sequential|none|linearizable")
-    read_pump = os.environ.get("COPYCAT_SERVER_READ_PUMP", "1") != "0"
+    read_pump = knobs.get_bool("COPYCAT_SERVER_READ_PUMP")
     capacity = 1 << max(4, (instances - 1).bit_length())
-    log_slots = int(os.environ.get("COPYCAT_BENCH_SPI_LOG_SLOTS", "16"))
+    log_slots = knobs.get_int("COPYCAT_BENCH_SPI_LOG_SLOTS")
     registry = LocalServerRegistry()
 
     async def drive() -> dict:
@@ -1070,14 +1071,13 @@ def run_cluster() -> dict:
     from .server.raft import LEADER, RaftServer
 
     ClusterAdd, ClusterGet, CounterMachine = _cluster_machine_types()
-    storage_level = os.environ.get(
-        "COPYCAT_BENCH_CLUSTER_STORAGE", "memory").lower()
-    members = int(os.environ.get("COPYCAT_BENCH_CLUSTER_MEMBERS", "3"))
-    n_clients = int(os.environ.get("COPYCAT_BENCH_CLUSTER_CLIENTS", "4"))
-    ops_per_client = int(os.environ.get("COPYCAT_BENCH_CLUSTER_OPS", "1500"))
-    bursts = int(os.environ.get("COPYCAT_BENCH_CLUSTER_BURSTS", "5"))
-    delay_ms = float(os.environ.get("COPYCAT_BENCH_CLUSTER_DELAY_MS", "2.0"))
-    pipelined = os.environ.get("COPYCAT_REPL_PIPELINE", "1") != "0"
+    storage_level = knobs.get_str("COPYCAT_BENCH_CLUSTER_STORAGE").lower()
+    members = knobs.get_int("COPYCAT_BENCH_CLUSTER_MEMBERS")
+    n_clients = knobs.get_int("COPYCAT_BENCH_CLUSTER_CLIENTS")
+    ops_per_client = knobs.get_int("COPYCAT_BENCH_CLUSTER_OPS")
+    bursts = knobs.get_int("COPYCAT_BENCH_CLUSTER_BURSTS")
+    delay_ms = knobs.get_float("COPYCAT_BENCH_CLUSTER_DELAY_MS")
+    pipelined = knobs.get_bool("COPYCAT_REPL_PIPELINE")
 
     async def drive() -> dict:
         registry = LocalServerRegistry()
@@ -1215,12 +1215,10 @@ def run_recovery() -> dict:
     from .server.raft import LEADER, RaftServer
 
     ClusterAdd, ClusterGet, CounterMachine = _cluster_machine_types()
-    ops = int(os.environ.get("COPYCAT_BENCH_RECOVERY_OPS", "6000"))
-    storage_level = os.environ.get(
-        "COPYCAT_BENCH_RECOVERY_STORAGE", "disk").lower()
-    snap_entries = os.environ.get("COPYCAT_BENCH_RECOVERY_SNAP_ENTRIES",
-                                  "512")
-    n_clients = int(os.environ.get("COPYCAT_BENCH_RECOVERY_CLIENTS", "4"))
+    ops = knobs.get_int("COPYCAT_BENCH_RECOVERY_OPS")
+    storage_level = knobs.get_str("COPYCAT_BENCH_RECOVERY_STORAGE").lower()
+    snap_entries = str(knobs.get_int("COPYCAT_BENCH_RECOVERY_SNAP_ENTRIES"))
+    n_clients = knobs.get_int("COPYCAT_BENCH_RECOVERY_CLIENTS")
 
     async def one_pass(snapshots_on: bool, port_base: int) -> dict:
         saved = {k: os.environ.get(k) for k in (
@@ -1361,10 +1359,8 @@ def run_election() -> dict:
     engine's 4-9 here so the number stays comparable across rounds;
     shorter timers complete forced elections proportionally faster."""
     config = Config(use_pallas=use_pallas(),
-                    timer_min=int(os.environ.get(
-                        "COPYCAT_BENCH_TIMER_MIN", "4")),
-                    timer_max=int(os.environ.get(
-                        "COPYCAT_BENCH_TIMER_MAX", "9")),
+                    timer_min=knobs.get_int("COPYCAT_BENCH_TIMER_MIN", default=4),
+                    timer_max=knobs.get_int("COPYCAT_BENCH_TIMER_MAX", default=9),
                     resource=RESOURCE_CONFIGS["election"])
     key = jax.random.PRNGKey(0)
     key, init_key = jax.random.split(key)
@@ -1427,7 +1423,7 @@ def run_map_read() -> dict:
     default, or lease-gated ATOMIC/BOUNDED_LINEARIZABLE reads with
     ``COPYCAT_BENCH_READ_LEVEL=atomic`` (reference
     ``Consistency.java:157-176``)."""
-    read_level = os.environ.get("COPYCAT_BENCH_READ_LEVEL", "sequential")
+    read_level = knobs.get_str("COPYCAT_BENCH_READ_LEVEL")
     if read_level not in ("sequential", "atomic"):
         raise SystemExit(
             f"COPYCAT_BENCH_READ_LEVEL={read_level!r}: pick 'sequential' "
@@ -1507,7 +1503,7 @@ def run_host_read() -> dict:
     counter first so reads return real state."""
     from .models import BulkDriver, RaftGroups
 
-    read_level = os.environ.get("COPYCAT_BENCH_READ_LEVEL", "sequential")
+    read_level = knobs.get_str("COPYCAT_BENCH_READ_LEVEL")
     if read_level not in ("sequential", "atomic"):
         # causal/process serve identically to sequential here — accepting
         # them would mislabel the metric (same guard as run_map_read)
@@ -1521,8 +1517,8 @@ def run_host_read() -> dict:
                                   applies_per_round=max(4, SUBMIT_SLOTS),
                                   monotone_tag_accept=True,
                                   resource=RESOURCE_CONFIGS["counter"]))
-    per_group = int(os.environ.get("COPYCAT_BENCH_HOST_BURST",
-                                   str(SUBMIT_SLOTS * 8)))
+    per_group = knobs.get_int("COPYCAT_BENCH_HOST_BURST",
+                              default=SUBMIT_SLOTS * 8)
     log(f"bench[host_read:{read_level}]: G={GROUPS} P={PEERS} "
         f"{per_group} reads/group/burst; device={jax.devices()[0].platform}")
     rg.wait_for_leaders()
@@ -1584,7 +1580,7 @@ def main() -> None:
     try:
         require_devices(env="COPYCAT_BENCH_DEVICE_TIMEOUT")
     except SystemExit:
-        if os.environ.get("COPYCAT_BENCH_NO_CPU_FALLBACK") == "1":
+        if knobs.get_bool("COPYCAT_BENCH_NO_CPU_FALLBACK"):
             raise
         log("bench: accelerator unreachable after all probes — "
             "DEGRADED CPU fallback (JAX_PLATFORMS=cpu)")

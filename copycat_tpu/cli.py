@@ -242,7 +242,16 @@ def _stats(args: argparse.Namespace) -> int:
 
 def main(argv: list[str] | None = None) -> None:
     """``copycat-tpu <verb>``: ``stats <host:port>`` reads a running
-    server's observability surface; ``serve`` is ``copycat-server``."""
+    server's observability surface; ``serve`` is ``copycat-server``;
+    ``lint`` runs the copycheck static-analysis suite (jax-free —
+    docs/ANALYSIS.md)."""
+    raw = sys.argv[1:] if argv is None else argv
+    if raw and raw[0] == "lint":
+        # copycheck owns its own argparse surface (docs/ANALYSIS.md);
+        # lazily imported so `lint` never pays for (or requires) jax
+        from .analysis.engine import main as lint_main
+
+        raise SystemExit(lint_main(raw[1:]))
     parser = argparse.ArgumentParser(prog="copycat-tpu")
     sub = parser.add_subparsers(dest="verb", required=True)
 
@@ -267,7 +276,14 @@ def main(argv: list[str] | None = None) -> None:
     serve = sub.add_parser("serve", help="run a standalone server node")
     serve.add_argument("rest", nargs=argparse.REMAINDER)
 
-    args = parser.parse_args(sys.argv[1:] if argv is None else argv)
+    # registered for --help discoverability; dispatched above before
+    # argparse so copycheck's own flags (--strict, --format...) pass
+    # through untouched
+    sub.add_parser("lint", help="run the copycheck static-analysis "
+                                "suite (docs/ANALYSIS.md)",
+                   add_help=False)
+
+    args = parser.parse_args(raw)
     if args.verb == "stats":
         raise SystemExit(_stats(args))
     if args.verb == "serve":

@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import asyncio
 import itertools
-import os
 import random
 import time
 import uuid
@@ -22,6 +21,7 @@ from typing import Any, Callable
 from ..io.transport import Address, Connection, Transport, TransportError
 from ..protocol import messages as msg
 from ..protocol.operations import Command, Operation, Query
+from ..utils import knobs
 from ..utils.listeners import Listener, Listeners
 from ..utils.managed import Managed
 from ..utils.metrics import MetricsRegistry
@@ -175,8 +175,7 @@ class RaftClient(Managed):
         # read throughput scales with replicas. Leader fallback on lag
         # refusal / unreachable follower. COPYCAT_CLIENT_FOLLOWER_READS=0
         # restores leader-pinned reads (the scale-out A/B knob).
-        self._follower_reads = os.environ.get(
-            "COPYCAT_CLIENT_FOLLOWER_READS", "1") != "0"
+        self._follower_reads = knobs.get_bool("COPYCAT_CLIENT_FOLLOWER_READS")
         self._read_connections: dict[Address, Connection] = {}
         self._read_rr = 0
 

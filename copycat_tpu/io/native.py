@@ -25,6 +25,7 @@ import threading
 from typing import Any, Callable
 
 from ..utils.metrics import MetricsRegistry
+from ..utils.tasks import spawn
 from .serializer import Serializer
 from .transport import (
     Address,
@@ -262,7 +263,7 @@ class NativeConnection(Connection):
             self._abort()
             return
         if kind == _REQUEST:
-            asyncio.get_running_loop().create_task(self._serve(corr, payload))
+            spawn(self._serve(corr, payload), name="native-serve")
             return
         future = self._pending.pop(corr, None)
         if future is not None and not future.done():

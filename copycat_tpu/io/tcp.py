@@ -25,6 +25,7 @@ import struct
 from typing import Any, Callable
 
 from ..utils.metrics import MetricsRegistry
+from ..utils.tasks import spawn
 from .codec import codec
 from .serializer import Serializer
 from .transport import (
@@ -61,7 +62,7 @@ class TcpConnection(Connection):
         self._m_frames_in = m.counter("frames_in")
         self._m_frames_out = m.counter("frames_out")
         self._m_burst = m.histogram("read_burst_frames")
-        self._reader_task = asyncio.get_running_loop().create_task(self._read_loop())
+        self._reader_task = spawn(self._read_loop(), name="tcp-read-loop")
 
     def _walk_frames(self, buf: bytes | bytearray) -> tuple[list, int]:
         """Every complete frame in ``buf`` as ``(kind, corr, message,
@@ -102,7 +103,6 @@ class TcpConnection(Connection):
         # reads costs one pass — bytes concatenation per chunk re-copied
         # the whole pending frame every read (quadratic in frame size)
         buf = bytearray()
-        loop = asyncio.get_running_loop()
         try:
             while True:
                 chunk = await self._reader.read(1 << 16)
@@ -119,7 +119,8 @@ class TcpConnection(Connection):
                 for kind, corr, message, ok in frames:
                     if kind == _REQUEST:
                         if ok:
-                            loop.create_task(self._serve(corr, message))
+                            spawn(self._serve(corr, message),
+                                  name="tcp-serve")
                         else:  # decode error: fail THIS request only
                             self._write_error(corr, message)
                     else:

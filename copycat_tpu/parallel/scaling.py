@@ -30,6 +30,7 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax
 import numpy as np
 
+from ..utils import knobs
 from ..utils.platform import honor_jax_platforms_env
 
 # JAX_PLATFORMS=cpu must WIN over plugin site config, or backend
@@ -37,9 +38,9 @@ from ..utils.platform import honor_jax_platforms_env
 # the same hazard the driver-graded entry points guard against.
 honor_jax_platforms_env()
 
-GROUPS = int(os.environ.get("COPYCAT_SCALING_GROUPS", "4096"))
+GROUPS = knobs.get_int("COPYCAT_SCALING_GROUPS")
 PEERS = 3
-ROUNDS = int(os.environ.get("COPYCAT_SCALING_ROUNDS", "30"))
+ROUNDS = knobs.get_int("COPYCAT_SCALING_ROUNDS")
 
 
 COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",

@@ -27,6 +27,7 @@ from ..io.serializer import Serializer
 from ..io.transport import Address, Connection, Transport, TransportError
 from ..protocol import messages as msg
 from ..protocol.operations import Command, CommandConsistency, QueryConsistency
+from ..utils import knobs
 from ..utils.managed import Managed
 from ..utils.metrics import MetricsRegistry
 from ..utils.scheduled import Scheduled
@@ -215,21 +216,17 @@ class RaftServer(Managed):
         # pipeline's initial/ceiling window size (adaptive between
         # ceiling//8 and ceiling on ack latency); the in-flight entry
         # cap bounds how much log a slow follower can pin.
-        self._repl_pipeline = os.environ.get(
-            "COPYCAT_REPL_PIPELINE", "1") != "0"
-        self._repl_window = max(1, int(os.environ.get(
-            "COPYCAT_REPL_WINDOW", "64")))
-        self._repl_depth = max(1, int(os.environ.get(
-            "COPYCAT_REPL_DEPTH", "8")))
-        self._repl_max_inflight = max(self._repl_window, int(os.environ.get(
+        self._repl_pipeline = knobs.get_bool("COPYCAT_REPL_PIPELINE")
+        self._repl_window = max(1, knobs.get_int("COPYCAT_REPL_WINDOW"))
+        self._repl_depth = max(1, knobs.get_int("COPYCAT_REPL_DEPTH"))
+        self._repl_max_inflight = max(self._repl_window, knobs.get_int(
             "COPYCAT_REPL_MAX_INFLIGHT",
-            str(self._repl_window * self._repl_depth))))
+            default=self._repl_window * self._repl_depth))
         # COPYCAT_INVARIANTS=strict (shared with the device plane's
         # monitors): every commit advance re-verifies quorum support
         # from match_index and raises on violation — the nemesis suite's
         # "pipelining never outruns a real quorum" tripwire.
-        self._strict_invariants = os.environ.get(
-            "COPYCAT_INVARIANTS", "") == "strict"
+        self._strict_invariants = knobs.get_str("COPYCAT_INVARIANTS", default="") == "strict"
 
         # apply-side bookkeeping
         self._commit_futures: dict[int, asyncio.Future] = {}  # index -> (result, error)
@@ -259,8 +256,7 @@ class RaftServer(Managed):
         # instead of per-op generator chains. Default on; the env knob
         # exists for the per-op A/B (BENCH_SCENARIOS.md spi table) and as
         # an escape hatch.
-        self._vector_pump = os.environ.get(
-            "COPYCAT_SERVER_VECTOR_PUMP", "1") != "0"
+        self._vector_pump = knobs.get_bool("COPYCAT_SERVER_VECTOR_PUMP")
 
         # Batched read pump (the read-plane analog of the vector pump):
         # device-eligible reads arriving across sessions and
@@ -271,8 +267,7 @@ class RaftServer(Managed):
         # query_step engine round. Default on; COPYCAT_SERVER_READ_PUMP=0
         # keeps the per-op lane bit-identically (the readmix A/B knob,
         # BENCH_SCENARIOS.md).
-        self._read_pump = os.environ.get(
-            "COPYCAT_SERVER_READ_PUMP", "1") != "0"
+        self._read_pump = knobs.get_bool("COPYCAT_SERVER_READ_PUMP")
         self._read_windows: dict[str, list] = {}  # consistency -> items
         self._read_flush_scheduled = False
 
@@ -331,18 +326,17 @@ class RaftServer(Managed):
         # next_index fell behind the truncated log (InstallRequest chunks
         # riding the replication pipeline). COPYCAT_SNAPSHOTS=0 restores
         # the replay-only lane bit-identically (the recovery A/B knob).
-        self._snap_enabled = os.environ.get("COPYCAT_SNAPSHOTS", "1") != "0"
-        self._snap_every = max(1, int(os.environ.get(
-            "COPYCAT_SNAPSHOT_ENTRIES", "1024")))
+        self._snap_enabled = knobs.get_bool("COPYCAT_SNAPSHOTS")
+        self._snap_every = max(1, knobs.get_int("COPYCAT_SNAPSHOT_ENTRIES"))
         # entries kept BEHIND the snapshot boundary so slightly-lagging
         # followers catch up from the log instead of paying an install;
         # the default covers the replication pipeline's whole in-flight
         # budget — a healthy follower's lag under backpressure is bounded
         # by COPYCAT_REPL_MAX_INFLIGHT, so truncation never outruns it
-        self._snap_retain = max(0, int(os.environ.get(
-            "COPYCAT_SNAPSHOT_RETAIN", str(max(64, self._repl_max_inflight)))))
-        self._snap_chunk = max(4096, int(os.environ.get(
-            "COPYCAT_SNAP_CHUNK", str(256 * 1024))))
+        self._snap_retain = max(0, knobs.get_int(
+            "COPYCAT_SNAPSHOT_RETAIN",
+            default=max(64, self._repl_max_inflight)))
+        self._snap_chunk = max(4096, knobs.get_int("COPYCAT_SNAP_CHUNK"))
         self._snapshots: SnapshotStore | None = None
         if self.storage.directory:
             self._snapshots = SnapshotStore(
@@ -748,7 +742,8 @@ class RaftServer(Managed):
                 return False
             return bool(response.voted) and response.term == term
 
-        tasks = [asyncio.ensure_future(request_vote(p)) for p in self.peers]
+        tasks = [spawn(request_vote(p), name="request-vote")
+                 for p in self.peers]
         for fut in asyncio.as_completed(tasks):
             granted = await fut
             if self.role != CANDIDATE or self.term != term:
@@ -776,8 +771,8 @@ class RaftServer(Managed):
             self.next_index[peer] = self.log.last_index + 1
             self.match_index[peer] = 0
             self._replication_events[peer] = asyncio.Event()
-            self._replication_tasks[peer] = asyncio.get_running_loop().create_task(
-                self._replicate_loop(peer))
+            self._replication_tasks[peer] = spawn(
+                self._replicate_loop(peer), name=f"replicate-{peer}")
         self._last_quorum_contact = {self.address: time.monotonic()}
         # Reset every open session's contact clock: last_contact is
         # LEADER-LOCAL wall time (replicated keep-alives advance only the
@@ -2561,8 +2556,9 @@ class RaftServer(Managed):
                     self.next_index[peer] = self.log.last_index + 1
                     self.match_index[peer] = 0
                     self._replication_events[peer] = asyncio.Event()
-                    self._replication_tasks[peer] = asyncio.get_running_loop().create_task(
-                        self._replicate_loop(peer))
+                    self._replication_tasks[peer] = spawn(
+                        self._replicate_loop(peer),
+                        name=f"replicate-{peer}")
             for peer in list(self._replication_tasks):
                 if peer not in self.members:
                     self._replication_tasks.pop(peer).cancel()

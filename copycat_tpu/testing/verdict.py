@@ -21,10 +21,10 @@ from __future__ import annotations
 
 import json
 import math
-import os
 import sys
 import time
 
+from ..utils import knobs
 from ..utils.platform import honor_jax_platforms_env
 
 honor_jax_platforms_env()
@@ -43,20 +43,20 @@ from .linearize import (
 )
 from .nemesis import Nemesis
 
-GROUPS = int(os.environ.get("COPYCAT_VERDICT_GROUPS", "10000"))
-SAMPLE = int(os.environ.get("COPYCAT_VERDICT_SAMPLE", "99"))
-ROUNDS = int(os.environ.get("COPYCAT_VERDICT_ROUNDS", "1000"))
-SEED = int(os.environ.get("COPYCAT_VERDICT_SEED", "42"))
+GROUPS = knobs.get_int("COPYCAT_VERDICT_GROUPS")
+SAMPLE = knobs.get_int("COPYCAT_VERDICT_SAMPLE")
+ROUNDS = knobs.get_int("COPYCAT_VERDICT_ROUNDS")
+SEED = knobs.get_int("COPYCAT_VERDICT_SEED")
 # ops per sampled group per round (round-3 depth was one op every 4
 # rounds ≈ 100 ops/group; VERDICT r3 #7 wants ≥1k — the windowed checker
 # keeps the deeper histories tractable)
-OP_EVERY_ROUNDS = max(1, int(os.environ.get("COPYCAT_VERDICT_OP_EVERY", "1")))
+OP_EVERY_ROUNDS = max(1, knobs.get_int("COPYCAT_VERDICT_OP_EVERY"))
 # Bounded client concurrency per group (a real client's pipelining
 # window): without it a long fault piles up in-flight recorded ops
 # (observed: 2,105 pending at round 300), leaving incomplete ops that
 # both distort the workload and make the checker's incomplete-op subsets
 # explode.
-MAX_INFLIGHT = max(1, int(os.environ.get("COPYCAT_VERDICT_INFLIGHT", "4")))
+MAX_INFLIGHT = max(1, knobs.get_int("COPYCAT_VERDICT_INFLIGHT"))
 BACKGROUND_PER_ROUND = 500  # untracked load spread over the other groups
 # Membership churn (default ON): groups run 5 peer lanes with 3 initial
 # voters and the nemesis is joined by server join/leave — every sampled
@@ -64,17 +64,17 @@ BACKGROUND_PER_ROUND = 500  # untracked load spread over the other groups
 # is recorded. Jepsen's hardest configuration for the reference is
 # exactly faults + membership changes together; linearizability of
 # client ops must hold across config changes.
-CHURN = os.environ.get("COPYCAT_VERDICT_CHURN", "1") == "1"
+CHURN = knobs.get_bool("COPYCAT_VERDICT_CHURN")
 CHURN_PERIOD = 20
 CHURN_CYCLE = (("add", 3), ("add", 4), ("remove", 3), ("remove", 4))
 # Deep-plane block (VERDICT r4 #4): drive the monotone-tag pipelined
 # plane — the path the north-star number rides — under per-epoch static
 # faults, and Wing-&-Gong-check the recorded histories. Off with
 # COPYCAT_VERDICT_DEEP=0.
-DEEP = os.environ.get("COPYCAT_VERDICT_DEEP", "1") == "1"
-DEEP_GROUPS = int(os.environ.get("COPYCAT_VERDICT_DEEP_GROUPS", "2000"))
-DEEP_SAMPLE = int(os.environ.get("COPYCAT_VERDICT_DEEP_SAMPLE", "48"))
-DEEP_EPOCHS = int(os.environ.get("COPYCAT_VERDICT_DEEP_EPOCHS", "40"))
+DEEP = knobs.get_bool("COPYCAT_VERDICT_DEEP")
+DEEP_GROUPS = knobs.get_int("COPYCAT_VERDICT_DEEP_GROUPS")
+DEEP_SAMPLE = knobs.get_int("COPYCAT_VERDICT_DEEP_SAMPLE")
+DEEP_EPOCHS = knobs.get_int("COPYCAT_VERDICT_DEEP_EPOCHS")
 DEEP_OPS_PER_EPOCH = 4          # recorded ops / sampled group / epoch
 
 
@@ -599,7 +599,7 @@ def main() -> None:
     # COPYCAT_VERDICT_ARTIFACT=0 skips rewriting LINEARIZABILITY.md — the
     # committed artifact records the BENCH-scale verdict; smoke runs (CI,
     # local debugging at small GROUPS) must not clobber it.
-    if os.environ.get("COPYCAT_VERDICT_ARTIFACT", "1") == "1":
+    if knobs.get_bool("COPYCAT_VERDICT_ARTIFACT"):
         _write_artifact(result)
     print(json.dumps(result))
     if not result["linearizable"]:

@@ -1,0 +1,421 @@
+"""Central registry for every ``COPYCAT_*`` environment knob.
+
+Every env knob the tree reads is declared HERE, once, with a typed
+default and a one-line doc — and read through the typed getters below.
+Two gates keep that true:
+
+- the ``knob-registry`` copycheck rule (``copycat_tpu/analysis``) flags
+  any direct ``os.environ`` / ``os.getenv`` read of a ``COPYCAT_*``
+  name outside this module, and any ``knobs.get_*`` call naming an
+  unregistered knob;
+- ``tests/test_knobs.py`` asserts the README's *Knob reference* section
+  is byte-identical to :func:`render_markdown` (regenerate with
+  ``python -m copycat_tpu.utils.knobs``).
+
+Getters read ``os.environ`` live (no caching): tests and benches
+monkeypatch knobs mid-process and expect the next server/client built
+to see the change — exactly what the raw reads they replace did.
+
+Call sites whose default is computed (e.g. ``COPYCAT_SNAPSHOT_RETAIN``
+defaults to ``max(64, repl max-inflight)``) pass ``default=`` at the
+call; the registry carries a ``default_doc`` string so the README table
+still documents the rule. Boolean knobs normalize: ``0 / false / off /
+no / none`` and the empty string are off, anything else set is on.
+
+This module is import-light on purpose (stdlib ``os`` only): the lint
+CLI, the README generator, and the analysis rules all load it without
+touching jax.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any
+
+_FALSY = ("", "0", "false", "off", "no", "none")
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    kind: str  # "int" | "float" | "str" | "bool" | "raw"
+    default: Any  # typed default; None = computed at the call site / unset
+    doc: str  # one-line effect (the README table cell)
+    section: str
+    default_doc: str | None = None  # README text for computed defaults
+    choices: tuple[str, ...] | None = None
+
+    def default_text(self) -> str:
+        if self.default_doc is not None:
+            return self.default_doc
+        if self.default is None:
+            return "unset"
+        if self.kind == "bool":
+            return "1" if self.default else "0"
+        return str(self.default) or "(empty)"
+
+
+REGISTRY: dict[str, Knob] = {}
+
+# README section order; every knob names one of these.
+SECTIONS = (
+    ("server", "Server planes (vector + read pump)"),
+    ("replication", "Replication pipeline"),
+    ("durability", "Snapshots & durability"),
+    ("observability", "Observability & invariants"),
+    ("client", "Client"),
+    ("platform", "Platform & device probing"),
+    ("bench", "Bench scenarios (`bench.py`)"),
+    ("scaling", "Multichip scaling driver"),
+    ("verdict", "Linearizability verdict runner"),
+)
+_SECTION_KEYS = tuple(key for key, _ in SECTIONS)
+
+
+def _knob(name: str, kind: str, default: Any, doc: str, *, section: str,
+          default_doc: str | None = None,
+          choices: tuple[str, ...] | None = None) -> None:
+    assert name not in REGISTRY, f"duplicate knob {name}"
+    assert section in _SECTION_KEYS, f"unknown section {section!r} ({name})"
+    REGISTRY[name] = Knob(name, kind, default, doc, section, default_doc,
+                          choices)
+
+
+# --- server planes ---------------------------------------------------------
+_knob("COPYCAT_SERVER_VECTOR_PUMP", "bool", True,
+      "`0` restores the per-op command apply lane (the spi A/B)",
+      section="server")
+_knob("COPYCAT_SERVER_READ_PUMP", "bool", True,
+      "`0` restores the per-op read lane (the readmix A/B)",
+      section="server")
+
+# --- replication -----------------------------------------------------------
+_knob("COPYCAT_REPL_PIPELINE", "bool", True,
+      "`0` restores stop-and-wait replication (the A/B lane)",
+      section="replication")
+_knob("COPYCAT_REPL_WINDOW", "int", 64,
+      "append window size: pipeline initial/ceiling AND the stop-and-wait "
+      "window", section="replication")
+_knob("COPYCAT_REPL_DEPTH", "int", 8,
+      "max append windows in flight per peer", section="replication")
+_knob("COPYCAT_REPL_MAX_INFLIGHT", "int", None, default_doc="window×depth",
+      doc="max entries in flight per peer (slow-follower memory bound)",
+      section="replication")
+
+# --- durability ------------------------------------------------------------
+_knob("COPYCAT_SNAPSHOTS", "bool", True,
+      "`0` restores replay-only recovery bit-identically (the A/B lane)",
+      section="durability")
+_knob("COPYCAT_SNAPSHOT_ENTRIES", "int", 1024,
+      "applied entries between snapshots (bounds recovery replay)",
+      section="durability")
+_knob("COPYCAT_SNAPSHOT_RETAIN", "int", None,
+      default_doc="max(64, repl max-inflight)",
+      doc="entries kept behind the snapshot so lagging-but-healthy "
+          "followers avoid an install", section="durability")
+_knob("COPYCAT_SNAP_CHUNK", "int", 262144,
+      "install-stream chunk bytes", section="durability")
+
+# --- observability ---------------------------------------------------------
+_knob("COPYCAT_TRACE", "bool", False,
+      "per-request tracing (`utils/tracing.py`); zero-cost when off",
+      section="observability")
+_knob("COPYCAT_TELEMETRY", "bool", False,
+      "compile the device telemetry block into engines whose `Config` "
+      "left it off", section="observability")
+_knob("COPYCAT_INVARIANTS", "str", None, default_doc="unset (= observe)",
+      choices=("observe", "strict", "off"),
+      doc="invariant monitors, device + server: `observe` counts "
+          "violations, `strict` raises, `off` skips checks; setting any "
+          "mode also enables device telemetry", section="observability")
+_knob("COPYCAT_INVARIANT_LEADERLESS_MAX", "float", 1.0,
+      "max leaderless-group fraction per fetched round before the "
+      "monitor trips", section="observability")
+
+# --- client ----------------------------------------------------------------
+_knob("COPYCAT_CLIENT_FOLLOWER_READS", "bool", True,
+      "`0` pins sub-linearizable reads back to the leader connection",
+      section="client")
+
+# --- platform --------------------------------------------------------------
+_knob("COPYCAT_COMPILE_CACHE", "raw", None,
+      default_doc="`~/.cache/copycat_tpu/xla`",
+      doc="XLA compile-cache directory; `0` or empty disables",
+      section="platform")
+_knob("COPYCAT_DEVICE_TIMEOUT", "float", 120.0,
+      "seconds per device-enumeration probe before declaring the "
+      "accelerator unreachable", section="platform")
+_knob("COPYCAT_DEVICE_PROBES", "int", None,
+      default_doc="5 (entry dryrun: 2)",
+      doc="device-enumeration probe attempts before failing",
+      section="platform")
+_knob("COPYCAT_ENTRY_DEVICE_TIMEOUT", "float", 120.0,
+      "probe timeout for the `__graft_entry__` multichip dryrun",
+      section="platform")
+_knob("COPYCAT_BENCH_DEVICE_TIMEOUT", "float", 120.0,
+      "probe timeout for bench runs (failed probes fall back to CPU "
+      "unless `COPYCAT_BENCH_NO_CPU_FALLBACK=1`)", section="platform")
+_knob("COPYCAT_VERDICT_DEVICE_TIMEOUT", "float", 120.0,
+      "probe timeout for the verdict runner", section="platform")
+
+# --- bench -----------------------------------------------------------------
+_knob("COPYCAT_BENCH_SCENARIO", "str", "counter",
+      "scenario: `counter`/`election`/`map`/`map_read`/`lock`/`mixed`/"
+      "`host`/`host_read`/`session`/`spi`/`readmix`/`cluster`/`recovery`",
+      section="bench")
+_knob("COPYCAT_BENCH_GROUPS", "int", None,
+      default_doc="10000 (election: 1000)",
+      doc="Raft groups in the engine tensor", section="bench")
+_knob("COPYCAT_BENCH_PEERS", "int", 3, "peer lanes per group",
+      section="bench")
+_knob("COPYCAT_BENCH_LOG_SLOTS", "int", None,
+      default_doc="64 (mixed: 32)",
+      doc="log-ring slots per group", section="bench")
+_knob("COPYCAT_BENCH_ROUNDS", "int", 200, "engine rounds per repetition",
+      section="bench")
+_knob("COPYCAT_BENCH_REPEATS", "int", 5,
+      "best-of-N repetitions recorded", section="bench")
+_knob("COPYCAT_BENCH_SUBMIT_SLOTS", "int", 16,
+      "submit slots per group (append window / applies-per-round floor)",
+      section="bench")
+_knob("COPYCAT_BENCH_PALLAS", "raw", None,
+      default_doc="auto (TPU: on, CPU: off)",
+      doc="`1` forces the Pallas quorum-tally kernel, any other set "
+          "value forces the jnp path", section="bench")
+_knob("COPYCAT_BENCH_POOL_BUDGETS", "str", None,
+      default_doc="per-scenario",
+      doc="comma-separated per-pool apply budgets "
+          "(value,map,set,queue,lock,election,multimap,topic); empty = "
+          "single sequential scan", section="bench")
+_knob("COPYCAT_BENCH_PROFILE", "str", "",
+      "directory for an XLA profiler trace of the first timed repetition",
+      section="bench")
+_knob("COPYCAT_BENCH_TELEMETRY", "bool", False,
+      "compile device telemetry into the measured step (the round-8 "
+      "on-cost A/B)", section="bench")
+_knob("COPYCAT_BENCH_TIMER_MIN", "int", None,
+      default_doc="4 (mixed: 2)",
+      doc="election timer lower bound, rounds", section="bench")
+_knob("COPYCAT_BENCH_TIMER_MAX", "int", None,
+      default_doc="9 (mixed: 4)",
+      doc="election timer upper bound, rounds", section="bench")
+_knob("COPYCAT_BENCH_HOST_MODE", "str", "deep",
+      choices=("deep", "deepscan", "bulk", "queued"),
+      doc="host-scenario driver lane", section="bench")
+_knob("COPYCAT_BENCH_HOST_BURST", "int", None,
+      default_doc="submit_slots×8 (queued: ×1)",
+      doc="ops per group per burst for the host/host_read scenarios",
+      section="bench")
+_knob("COPYCAT_BENCH_SESSIONS", "int", 16,
+      "sessions per group for the session scenario", section="bench")
+_knob("COPYCAT_BENCH_SESSION_SCAN", "bool", False,
+      "`1` drives the session scenario through the fused deep_scan",
+      section="bench")
+_knob("COPYCAT_BENCH_SPI_INSTANCES", "int", 1000,
+      "resource instances (sessions) for the spi/readmix scenarios",
+      section="bench")
+_knob("COPYCAT_BENCH_SPI_BURSTS", "int", 5,
+      "bursts per repetition for the spi/readmix scenarios",
+      section="bench")
+_knob("COPYCAT_BENCH_SPI_PAYLOAD", "str", "int", choices=("int", "str"),
+      doc="`int` = device-resident counters, `str` = host-shadow map "
+          "cliff", section="bench")
+_knob("COPYCAT_BENCH_SPI_POOLS", "str", None,
+      default_doc="counters (str payload: all)",
+      choices=("counters", "all"),
+      doc="engine pool provisioning for the spi scenario", section="bench")
+_knob("COPYCAT_BENCH_SPI_WAVES", "int", 1,
+      "client pipelining depth (commands in flight per instance)",
+      section="bench")
+_knob("COPYCAT_BENCH_SPI_TRANSPORT", "str", "local",
+      choices=("local", "tcp", "native"),
+      doc="transport under the spi scenario", section="bench")
+_knob("COPYCAT_BENCH_SPI_LOG_SLOTS", "int", 16,
+      "engine log-ring slots for the spi/readmix scenarios",
+      section="bench")
+_knob("COPYCAT_BENCH_READMIX_READS", "int", 9,
+      "reads per write in the readmix scenario", section="bench")
+_knob("COPYCAT_BENCH_READMIX_LEVEL", "str", "atomic",
+      choices=("atomic", "sequential", "none", "linearizable"),
+      doc="read consistency the readmix scenario requests", section="bench")
+_knob("COPYCAT_BENCH_READ_LEVEL", "str", "sequential",
+      choices=("sequential", "atomic"),
+      doc="read consistency for the map_read/host_read scenarios",
+      section="bench")
+_knob("COPYCAT_BENCH_CLUSTER_STORAGE", "str", "memory",
+      choices=("memory", "mapped", "disk"),
+      doc="log storage level for the cluster scenario (the durability "
+          "A/B; `bench.py --storage` sets it)", section="bench")
+_knob("COPYCAT_BENCH_CLUSTER_MEMBERS", "int", 3,
+      "cluster scenario member count", section="bench")
+_knob("COPYCAT_BENCH_CLUSTER_CLIENTS", "int", 4,
+      "concurrent clients in the cluster scenario", section="bench")
+_knob("COPYCAT_BENCH_CLUSTER_OPS", "int", 1500,
+      "ops per client per burst in the cluster scenario", section="bench")
+_knob("COPYCAT_BENCH_CLUSTER_BURSTS", "int", 5,
+      "bursts (best-of) in the cluster scenario", section="bench")
+_knob("COPYCAT_BENCH_CLUSTER_DELAY_MS", "float", 2.0,
+      "nemesis wire latency per leg, ms", section="bench")
+_knob("COPYCAT_BENCH_RECOVERY_OPS", "int", 6000,
+      "committed entries before the recovery scenario's catch-up",
+      section="bench")
+_knob("COPYCAT_BENCH_RECOVERY_STORAGE", "str", "disk",
+      choices=("memory", "mapped", "disk"),
+      doc="log storage level for the recovery scenario", section="bench")
+_knob("COPYCAT_BENCH_RECOVERY_SNAP_ENTRIES", "int", 512,
+      "snapshot cadence the recovery scenario pins", section="bench")
+_knob("COPYCAT_BENCH_RECOVERY_CLIENTS", "int", 4,
+      "concurrent clients in the recovery scenario", section="bench")
+_knob("COPYCAT_BENCH_NO_CPU_FALLBACK", "bool", False,
+      "`1` makes an unreachable accelerator FATAL instead of a degraded "
+      "CPU fallback", section="bench")
+
+# --- scaling ---------------------------------------------------------------
+_knob("COPYCAT_SCALING_GROUPS", "int", 4096,
+      "groups per bulk row in the multichip scaling driver",
+      section="scaling")
+_knob("COPYCAT_SCALING_ROUNDS", "int", 30,
+      "rounds per scaling measurement", section="scaling")
+
+# --- verdict ---------------------------------------------------------------
+_knob("COPYCAT_VERDICT_GROUPS", "int", 10000,
+      "groups in the verdict engine", section="verdict")
+_knob("COPYCAT_VERDICT_SAMPLE", "int", 99,
+      "groups whose histories are recorded and checked", section="verdict")
+_knob("COPYCAT_VERDICT_ROUNDS", "int", 1000,
+      "engine rounds driven under nemesis", section="verdict")
+_knob("COPYCAT_VERDICT_SEED", "int", 42, "workload/nemesis RNG seed",
+      section="verdict")
+_knob("COPYCAT_VERDICT_OP_EVERY", "int", 1,
+      "rounds between recorded ops per sampled group", section="verdict")
+_knob("COPYCAT_VERDICT_INFLIGHT", "int", 4,
+      "bounded client concurrency per sampled group", section="verdict")
+_knob("COPYCAT_VERDICT_CHURN", "bool", True,
+      "`0` disables membership churn during recording", section="verdict")
+_knob("COPYCAT_VERDICT_DEEP", "bool", True,
+      "`0` skips the deep-plane (monotone-tag pipelined) block",
+      section="verdict")
+_knob("COPYCAT_VERDICT_DEEP_GROUPS", "int", 2000,
+      "groups in the deep-plane block", section="verdict")
+_knob("COPYCAT_VERDICT_DEEP_SAMPLE", "int", 48,
+      "sampled groups in the deep-plane block", section="verdict")
+_knob("COPYCAT_VERDICT_DEEP_EPOCHS", "int", 40,
+      "fault epochs in the deep-plane block", section="verdict")
+_knob("COPYCAT_VERDICT_ARTIFACT", "bool", True,
+      "`0` skips rewriting LINEARIZABILITY.md (CI/smoke runs must not "
+      "clobber the bench-scale artifact)", section="verdict")
+
+
+# --- typed getters ---------------------------------------------------------
+
+
+def _lookup(name: str) -> Knob:
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"{name} is not a registered knob — declare it in "
+            f"copycat_tpu/utils/knobs.py (the knob-registry lint rule "
+            f"and the README table both feed off the registry)") from None
+
+
+def get_raw(name: str) -> str | None:
+    """The raw env value, or ``None`` when unset. For tri-state knobs
+    where *set at all* is meaningful (``COPYCAT_INVARIANTS``,
+    ``COPYCAT_BENCH_PALLAS``, ``COPYCAT_COMPILE_CACHE``)."""
+    _lookup(name)
+    return os.environ.get(name)
+
+
+def get_str(name: str, default: str | None = None) -> str:
+    knob = _lookup(name)
+    value = os.environ.get(name)
+    if value is None:
+        value = default if default is not None else knob.default
+    if value is None:
+        raise ValueError(f"{name} has no registered default; pass default=")
+    return value
+
+
+def get_int(name: str, default: int | None = None) -> int:
+    knob = _lookup(name)
+    value = os.environ.get(name)
+    if value is not None:
+        return int(value)
+    if default is not None:
+        return default
+    if knob.default is None:
+        raise ValueError(f"{name} has no registered default; pass default=")
+    return int(knob.default)
+
+
+def get_float(name: str, default: float | None = None) -> float:
+    knob = _lookup(name)
+    value = os.environ.get(name)
+    if value is not None:
+        return float(value)
+    if default is not None:
+        return default
+    if knob.default is None:
+        raise ValueError(f"{name} has no registered default; pass default=")
+    return float(knob.default)
+
+
+def get_bool(name: str, default: bool | None = None) -> bool:
+    knob = _lookup(name)
+    value = os.environ.get(name)
+    if value is None:
+        if default is not None:
+            return default
+        if knob.default is None:
+            raise ValueError(
+                f"{name} has no registered default; pass default=")
+        return bool(knob.default)
+    return value.strip().lower() not in _FALSY
+
+
+# --- README generation -----------------------------------------------------
+
+README_BEGIN = "<!-- knobs:begin (generated by python -m copycat_tpu.utils.knobs; do not edit by hand) -->"
+README_END = "<!-- knobs:end -->"
+
+
+def render_markdown() -> str:
+    """The full *Knob reference* body between the README markers —
+    one table per section, straight from the registry."""
+    lines: list[str] = []
+    for key, title in SECTIONS:
+        knobs = [k for k in REGISTRY.values() if k.section == key]
+        if not knobs:
+            continue
+        lines.append(f"### {title}")
+        lines.append("")
+        lines.append("| knob | default | effect |")
+        lines.append("|---|---|---|")
+        for k in knobs:  # registration order == doc order
+            doc = k.doc
+            if k.choices:
+                doc += " (" + "/".join(f"`{c}`" for c in k.choices) + ")"
+            lines.append(f"| `{k.name}` | `{k.default_text()}` | {doc} |")
+        lines.append("")
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def readme_section(readme_text: str) -> str | None:
+    """Extract the generated section from README text, or ``None`` when
+    the markers are missing."""
+    try:
+        start = readme_text.index(README_BEGIN) + len(README_BEGIN)
+        end = readme_text.index(README_END)
+    except ValueError:
+        return None
+    return readme_text[start:end].strip("\n") + "\n"
+
+
+def main() -> None:
+    print(render_markdown(), end="")
+
+
+if __name__ == "__main__":
+    main()

@@ -10,18 +10,9 @@ import asyncio
 import logging
 from typing import Any, Callable, Generic, Iterator, TypeVar
 
+from .tasks import spawn
+
 T = TypeVar("T")
-
-#: Strong references to in-flight async-callback tasks (see Listener.accept).
-_live_tasks: set = set()
-
-
-def _reap_task(task) -> None:
-    _live_tasks.discard(task)
-    if not task.cancelled() and task.exception() is not None:
-        logging.getLogger(__name__).error(
-            "async listener callback failed", exc_info=task.exception())
-
 
 class Listener(Generic[T]):
     """A single closeable callback registration.
@@ -43,10 +34,10 @@ class Listener(Generic[T]):
             return None
         result = self._callback(event)
         if asyncio.iscoroutine(result):
-            # Strong-ref the task until done: the loop keeps only weak
-            # refs, so a suspended callback could otherwise be GC'd
-            # mid-execution. Exceptions are logged (sync callbacks raise
-            # into the emitter; async ones cannot).
+            # tasks.spawn strong-refs the task until done (the loop
+            # keeps only weak refs, so a suspended callback could
+            # otherwise be GC'd mid-execution) and logs exceptions
+            # (sync callbacks raise into the emitter; async ones cannot).
             try:
                 asyncio.get_running_loop()
             except RuntimeError:
@@ -60,10 +51,7 @@ class Listener(Generic[T]):
                     "loop at dispatch (register sync callbacks for "
                     "off-loop emitters)")
                 return None
-            task = asyncio.ensure_future(result)
-            _live_tasks.add(task)
-            task.add_done_callback(_reap_task)
-            return task
+            return spawn(result, name="listener-callback")
         return result
 
     def close(self) -> None:

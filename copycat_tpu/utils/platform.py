@@ -5,6 +5,8 @@ from __future__ import annotations
 import os
 import re
 
+from . import knobs
+
 
 def honor_jax_platforms_env() -> None:
     """Re-assert the ``JAX_PLATFORMS`` env var against plugin site config.
@@ -45,7 +47,7 @@ def enable_compilation_cache(path: str | None = None) -> str | None:
     """
     explicit_path = path
     if path is None:
-        env = os.environ.get("COPYCAT_COMPILE_CACHE")
+        env = knobs.get_raw("COPYCAT_COMPILE_CACHE")
         if env is not None and env in ("", "0"):
             return None
         path = env or os.path.join(
@@ -196,8 +198,8 @@ def require_devices(env: str = "COPYCAT_DEVICE_TIMEOUT",
         _devices_verified = True
         return
 
-    timeout_s = float(os.environ.get(env, str(default_s)))
-    n_probes = max(1, int(os.environ.get(probes_env, str(default_probes))))
+    timeout_s = knobs.get_float(env, default=default_s)
+    n_probes = max(1, knobs.get_int(probes_env, default=default_probes))
     err = sys.stderr
 
     for attempt in range(1, n_probes + 1):

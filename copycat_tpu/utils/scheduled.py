@@ -37,7 +37,7 @@ class Scheduled:
         self._interval = interval
         self._callback = callback
         self._inflight: asyncio.Task | None = None
-        self._task: asyncio.Task | None = asyncio.get_running_loop().create_task(self._run())
+        self._task: asyncio.Task | None = spawn(self._run(), name="scheduled-timer")
 
     async def _run(self) -> None:
         try:

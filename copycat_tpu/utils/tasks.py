@@ -29,4 +29,8 @@ def _finish(task: asyncio.Task) -> None:
         return
     exc = task.exception()
     if exc is not None:
-        logger.error("background task %s failed: %r", task.get_name(), exc)
+        # exc_info keeps the traceback in the log record: background
+        # failures have no awaiter to re-raise into, so this line is
+        # the only place the stack ever surfaces
+        logger.error("background task %s failed: %r", task.get_name(),
+                     exc, exc_info=exc)

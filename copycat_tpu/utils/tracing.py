@@ -44,10 +44,11 @@ from __future__ import annotations
 
 import itertools
 import json
-import os
 import time
 from collections import OrderedDict
 from typing import Any
+
+from . import knobs
 
 _ids = itertools.count(1)
 
@@ -167,7 +168,7 @@ class Tracer:
 #: TCP each process keeps its own ring, correlated by trace id).
 TRACER = Tracer()
 
-if os.environ.get("COPYCAT_TRACE", "") not in ("", "0"):
+if knobs.get_bool("COPYCAT_TRACE"):
     TRACER.enabled = True
 
 

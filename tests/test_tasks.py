@@ -1,0 +1,100 @@
+"""utils/tasks.spawn lifecycle tests (satellite of the copycheck PR).
+
+``spawn`` is the tree's ONE blessed background-task spawn point (the
+``orphan-task`` rule enforces it), so its contract needs direct
+coverage: strong-ref until done, unexpected exceptions logged and
+discarded, cancellation silent, names attributed.
+"""
+
+import asyncio
+import gc
+import logging
+
+import pytest
+
+from copycat_tpu.utils import tasks
+from copycat_tpu.utils.tasks import spawn
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_spawn_returns_task_and_result_flows():
+    async def main():
+        task = spawn(asyncio.sleep(0, result=42), name="answer")
+        assert isinstance(task, asyncio.Task)
+        assert task.get_name() == "answer"
+        assert task in tasks._BACKGROUND  # strong ref while in flight
+        assert await task == 42
+        await asyncio.sleep(0)  # let the done callback run
+        assert task not in tasks._BACKGROUND
+
+    _run(main())
+
+
+def test_spawn_error_path_logs_and_discards(caplog):
+    async def boom():
+        raise RuntimeError("kaboom")
+
+    async def main():
+        with caplog.at_level(logging.ERROR, logger="copycat_tpu.utils.tasks"):
+            task = spawn(boom(), name="doomed")
+            # unobserved failure: nobody awaits the task
+            for _ in range(3):
+                await asyncio.sleep(0)
+            assert task.done()
+            assert task not in tasks._BACKGROUND  # discarded after done
+
+    _run(main())
+    messages = [r.getMessage() for r in caplog.records]
+    assert any("doomed" in m and "kaboom" in m for m in messages), messages
+
+
+def test_spawn_cancelled_task_is_silent(caplog):
+    async def forever():
+        await asyncio.Event().wait()
+
+    async def main():
+        with caplog.at_level(logging.ERROR, logger="copycat_tpu.utils.tasks"):
+            task = spawn(forever(), name="cancelled")
+            await asyncio.sleep(0)
+            task.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await task
+            await asyncio.sleep(0)
+            assert task not in tasks._BACKGROUND
+
+    _run(main())
+    assert caplog.records == [], [r.getMessage() for r in caplog.records]
+
+
+def test_spawn_survives_gc_without_external_reference():
+    """The weakref hazard spawn exists to close: a fire-and-forget task
+    must run to completion even when the caller drops its handle and a
+    collection happens mid-flight."""
+    results: list[int] = []
+
+    async def work():
+        await asyncio.sleep(0)
+        gc.collect()  # would reap a weakly-held task here
+        await asyncio.sleep(0)
+        results.append(7)
+
+    async def main():
+        spawn(work())  # handle dropped immediately
+        gc.collect()
+        for _ in range(5):
+            await asyncio.sleep(0)
+
+    _run(main())
+    assert results == [7]
+
+
+def test_spawn_requires_running_loop():
+    coro = asyncio.sleep(0)
+    try:
+        with pytest.raises(RuntimeError):
+            spawn(coro)
+    finally:
+        coro.close()  # avoid the never-awaited warning

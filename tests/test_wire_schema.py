@@ -1,0 +1,68 @@
+"""Golden wire-schema test (satellite of the copycheck PR).
+
+The wire format is positional: ``@serialize_with(id)`` + ``_fields``
+order IS the encoding. This test freezes the *runtime* schema — the
+actual registered classes, not the AST view (`tests/test_copycheck.py`
+covers that one and proves both views agree) — against
+``tests/golden/wire_schema.json``.
+
+If it fails because you intentionally changed the protocol:
+
+    copycat-tpu lint --update-golden
+
+then commit the regenerated ``tests/golden/wire_schema.json`` so the
+schema change is an explicit, reviewable diff.
+"""
+
+import json
+import os
+
+from copycat_tpu.io import serializer
+from copycat_tpu.protocol import messages as msg
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "wire_schema.json")
+REGEN = ("schema drift — if intentional, run `copycat-tpu lint "
+         "--update-golden` and commit tests/golden/wire_schema.json")
+
+
+def _runtime_schema() -> dict:
+    out = {}
+    for type_id, cls in serializer._TYPE_REGISTRY.items():
+        if cls.__module__ == msg.__name__ and issubclass(cls, msg.Message):
+            out[str(type_id)] = [cls.__name__, list(cls._fields)]
+    return out
+
+
+def test_protocol_ids_unique_and_in_reserved_block():
+    schema = _runtime_schema()
+    assert schema, "no protocol messages registered?"
+    for type_id in schema:
+        assert 200 <= int(type_id) <= 229, (
+            f"id {type_id} outside the protocol block 200-229 "
+            f"(messages.py docstring)")
+
+
+def test_runtime_schema_matches_golden():
+    with open(GOLDEN, encoding="utf-8") as f:
+        golden = json.load(f)
+    current = _runtime_schema()
+    assert current.keys() == golden.keys(), (
+        f"type-id set drifted: only-in-code="
+        f"{sorted(set(current) - set(golden), key=int)} only-in-golden="
+        f"{sorted(set(golden) - set(current), key=int)}; {REGEN}")
+    for type_id in sorted(golden, key=int):
+        assert current[type_id] == golden[type_id], (
+            f"id {type_id}: golden {golden[type_id]} != code "
+            f"{current[type_id]} — field ORDER is the wire encoding; "
+            f"{REGEN}")
+
+
+def test_every_message_field_list_is_complete():
+    """Responses must carry the uniform error surface the clients
+    expect; requests carrying sessions must name session_id first-class
+    (positional walk in the C codec)."""
+    for cls_name, fields in _runtime_schema().values():
+        cls = getattr(msg, cls_name)
+        if issubclass(cls, msg.Response):
+            assert "error" in fields, f"{cls_name} lacks `error`"
