@@ -1,7 +1,8 @@
 """``await-tear``: unguarded protected-state writes after an ``await``.
 
 The asyncio analogue of a race detector, specialized to the Raft
-server's transition methods (``server/raft.py``). Single-threaded
+server's transition methods (``server/raft.py`` + the multi-group
+``server/raft_group.py`` it was refactored into). Single-threaded
 asyncio removes data races but not *interleavings*: every ``await`` is a
 point where another coroutine can run a whole election, append, or
 snapshot install. A method that (1) reads protected Raft state, (2)
@@ -10,10 +11,17 @@ transition — exactly the bug class "On the parallels between Paxos and
 Raft" catalogs as quorum-era confusion, and the one the flight recorder
 only catches after the fact, on device.
 
-Protected state: ``self.term``, ``self.voted_for``,
-``self.commit_index``, ``self.last_applied``, and the log tail (writes
-via ``self.log.append/append_replicated_block/truncate/truncate_prefix/
-reset_to/compact``, reads via any other ``self.log.*`` use).
+Protected state lives on the GROUP-STATE object since the multi-raft
+refactor (docs/SHARDING.md): ``term``, ``voted_for``, ``commit_index``,
+``last_applied``, and the log tail (writes via
+``<state>.log.append/append_replicated_block/truncate/truncate_prefix/
+reset_to/compact``, reads via any other ``<state>.log.*`` use). The
+rule is base-aware rather than hard-coded to ``self``: inside
+``RaftGroup`` methods the base is ``self``; server-level code reaching
+through a group alias (``grp = self.groups[k]; ... grp.term = x``) is
+tracked under that alias, and a read/guard only discharges a write on
+the SAME base — re-validating ``other.term`` does not bless a write to
+``grp.term``.
 
 The blessed pattern re-validates after the await — the epoch guard the
 election path already uses::
@@ -26,12 +34,13 @@ election path already uses::
 
 Concretely: a write to a protected field is flagged when (a) at least
 one ``await`` precedes it in the method, (b) the same field was read
-*before* that await (the decision input), and (c) no ``if``/``while``
-test between the last preceding await and the write re-reads that field
-or ``self.role``. The rule is lexical (source order, not CFG paths) —
-deliberately so: a guard that only covers one branch still re-reads the
-state, and a method complex enough to defeat the lexical view belongs in
-the baseline with a justification, not silently passed.
+*on the same base* before that await (the decision input), and (c) no
+``if``/``while``/``assert`` test between the last preceding await and
+the write re-reads that field or ``role`` on that base. The rule is
+lexical (source order, not CFG paths) — deliberately so: a guard that
+only covers one branch still re-reads the state, and a method complex
+enough to defeat the lexical view belongs in the baseline with a
+justification, not silently passed.
 """
 
 from __future__ import annotations
@@ -47,24 +56,26 @@ LOG_WRITE_METHODS = ("append", "append_replicated_block", "truncate",
 GUARD_FIELDS = PROTECTED_FIELDS + ("role", "log")
 
 
-def _self_attr(node: ast.AST) -> str | None:
-    """``self.X`` -> ``X`` for protected fields; ``self.log`` -> 'log'."""
+def _base_attr(node: ast.AST) -> tuple[str, str] | None:
+    """``<name>.X`` -> ``(name, X)`` for any simple-name base (``self``,
+    a group alias like ``grp``/``g0``, ...)."""
     if (isinstance(node, ast.Attribute)
-            and isinstance(node.value, ast.Name)
-            and node.value.id == "self"):
-        return node.attr
+            and isinstance(node.value, ast.Name)):
+        return node.value.id, node.attr
     return None
 
 
 class _Events(ast.NodeVisitor):
     """Collect (line-ordered) reads, writes, awaits and guard tests for
-    one async function body, without descending into nested defs."""
+    one async function body, without descending into nested defs.
+    Events are keyed ``(base, field)`` so group-state aliases track
+    independently of ``self`` and of each other."""
 
     def __init__(self) -> None:
-        self.reads: list[tuple[int, str]] = []
-        self.writes: list[tuple[int, str]] = []
+        self.reads: list[tuple[int, tuple[str, str]]] = []
+        self.writes: list[tuple[int, tuple[str, str]]] = []
         self.awaits: list[int] = []
-        self.guards: list[tuple[int, str]] = []
+        self.guards: list[tuple[int, tuple[str, str]]] = []
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         pass  # nested sync def: its own context
@@ -81,12 +92,13 @@ class _Events(ast.NodeVisitor):
 
     def _note_test(self, test: ast.AST) -> None:
         for sub in ast.walk(test):
-            attr = _self_attr(sub)
-            if attr in PROTECTED_FIELDS or attr == "role":
-                self.guards.append((test.lineno, attr))
-            elif (isinstance(sub, ast.Attribute)
-                  and _self_attr(sub.value) == "log"):
-                self.guards.append((test.lineno, "log"))
+            rec = _base_attr(sub)
+            if rec is not None and rec[1] in PROTECTED_FIELDS + ("role",):
+                self.guards.append((test.lineno, rec))
+            elif isinstance(sub, ast.Attribute):
+                inner = _base_attr(sub.value)
+                if inner is not None and inner[1] == "log":
+                    self.guards.append((test.lineno, (inner[0], "log")))
 
     def visit_If(self, node: ast.If) -> None:
         self._note_test(node.test)
@@ -101,36 +113,41 @@ class _Events(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
-        attr = _self_attr(node)
-        if attr in PROTECTED_FIELDS:
+        rec = _base_attr(node)
+        if rec is not None and rec[1] in PROTECTED_FIELDS:
             if isinstance(node.ctx, (ast.Store, ast.Del)):
-                self.writes.append((node.lineno, attr))
+                self.writes.append((node.lineno, rec))
             else:
-                self.reads.append((node.lineno, attr))
-        elif (_self_attr(node.value) == "log"
-              and isinstance(node.ctx, ast.Load)):
-            # self.log.last_index / .term_at — a log-tail read (write
-            # methods are classified in visit_Call; an extra read note
-            # on the same line is harmless)
-            self.reads.append((node.lineno, "log"))
+                self.reads.append((node.lineno, rec))
+        else:
+            inner = _base_attr(node.value)
+            if inner is not None and inner[1] == "log" \
+                    and isinstance(node.ctx, ast.Load):
+                # <base>.log.last_index / .term_at — a log-tail read
+                # (write methods are classified in visit_Call; an extra
+                # read note on the same line is harmless)
+                self.reads.append((node.lineno, (inner[0], "log")))
         self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call) -> None:
-        # self.log.append(...) and friends: log-tail writes; any other
-        # self.log.X(...) counts as a log read (term_at, last_index...).
+        # <base>.log.append(...) and friends: log-tail writes; any other
+        # <base>.log.X(...) counts as a log read (term_at, last_index...).
         func = node.func
-        if (isinstance(func, ast.Attribute)
-                and _self_attr(func.value) == "log"):
-            if func.attr in LOG_WRITE_METHODS:
-                self.writes.append((node.lineno, "log"))
-            else:
-                self.reads.append((node.lineno, "log"))
+        if isinstance(func, ast.Attribute):
+            inner = _base_attr(func.value)
+            if inner is not None and inner[1] == "log":
+                key = (inner[0], "log")
+                if func.attr in LOG_WRITE_METHODS:
+                    self.writes.append((node.lineno, key))
+                else:
+                    self.reads.append((node.lineno, key))
         self.generic_visit(node)
 
 
 def check_await_tear(tree: ast.Module, path: str) -> list[Finding]:
-    # Specialized to the Raft server (fixture tests hand in any path
-    # whose basename mentions raft).
+    # Specialized to the Raft server plane: server/raft.py AND the
+    # per-group core server/raft_group.py (fixture tests hand in any
+    # path whose basename mentions raft).
     if "raft" not in path.rsplit("/", 1)[-1]:
         return []
     findings: list[Finding] = []
@@ -140,26 +157,26 @@ def check_await_tear(tree: ast.Module, path: str) -> list[Finding]:
             events.visit(stmt)
         if not events.awaits:
             continue
-        for wline, field in events.writes:
+        for wline, (base, field) in events.writes:
             awaits_before = [a for a in events.awaits if a < wline]
             if not awaits_before:
                 continue
             last_await = max(awaits_before)
-            stale_read = any(r < last_await and f == field
-                             for r, f in events.reads)
+            stale_read = any(r < last_await and key == (base, field)
+                             for r, key in events.reads)
             if not stale_read:
                 continue
             guarded = any(last_await < g <= wline
-                          and gf in (field, "role")
-                          for g, gf in events.guards)
+                          and gb == base and gf in (field, "role")
+                          for g, (gb, gf) in events.guards)
             if guarded:
                 continue
             findings.append(Finding(
                 rule="await-tear", path=path, line=wline,
-                message=(f"write to protected `self.{field}` after an "
+                message=(f"write to protected `{base}.{field}` after an "
                          f"await with no re-validation of `{field}`/"
-                         f"`role` between the interleaving point and the "
-                         f"write — re-check the epoch before committing "
-                         f"the transition"),
+                         f"`role` on `{base}` between the interleaving "
+                         f"point and the write — re-check the epoch "
+                         f"before committing the transition"),
                 symbol=qual))
     return findings

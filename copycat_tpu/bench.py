@@ -41,6 +41,14 @@ Scenarios (``COPYCAT_BENCH_SCENARIO``, BASELINE.md benchmark configs):
   knob is ``COPYCAT_REPL_PIPELINE`` (docs/REPLICATION.md); ``--storage
   {memory,mapped,disk}`` runs the same workload on a durable log level
   (the durability A/B, docs/DURABILITY.md).
+- ``sharded``: the multi-raft keyspace-sharding scenario
+  (docs/SHARDING.md) — a 3-member cluster hosting ``--groups N`` Raft
+  groups with leadership spread, many clients, zipfian keys, under a
+  cross-region wire delay where the bounded replication window caps a
+  single ordered log; headline value is committed ops/sec, with
+  groups-led / per-group-commit / routing-mix in the artifact. The A/B
+  knob is ``--groups 1`` (the single-group plane, which
+  ``COPYCAT_MULTI_GROUP=0`` pins bit-identically).
 - ``recovery``: the crash-recovery scenario — a fresh member catching up
   to a loaded, compacted cluster via snapshot-install streaming vs full
   log replay (``COPYCAT_SNAPSHOTS`` A/B inside one run); headline value
@@ -1007,6 +1015,17 @@ def _cluster_machine_types():
         def restore_state(self, data, sessions) -> None:
             self.data = dict(data["data"])
 
+        # keyspace sharding (docs/SHARDING.md): the sharded scenario
+        # routes counters across Raft groups by a stable key hash —
+        # identical on every member and across restarts
+        @classmethod
+        def route_group(cls, operation, groups: int) -> int:
+            import zlib
+            key = getattr(operation, "key", None)
+            if isinstance(key, str):
+                return zlib.crc32(key.encode()) % groups
+            return 0
+
     _ClusterAdd, _ClusterGet = ClusterAdd, ClusterGet
     _ClusterCounterMachine = CounterMachine
     return ClusterAdd, ClusterGet, CounterMachine
@@ -1187,6 +1206,210 @@ def run_cluster() -> dict:
                 except Exception:
                     pass
             cleanup_storage()
+
+    return asyncio.run(drive())
+
+
+def run_sharded() -> dict:
+    """Multi-raft keyspace sharding bench (docs/SHARDING.md): committed
+    ops/sec through a 3-member cluster hosting ``--groups N`` Raft
+    groups, many clients, zipfian keys, writes through the public
+    ``RaftClient`` API.
+
+    The wire shape is CROSS-REGION: a fixed per-leg nemesis delay
+    (``COPYCAT_BENCH_SHARDED_DELAY_MS``, default 100 ms -> 200 ms RTT)
+    makes the bounded replication pipeline the binding constraint — a
+    single ordered log cannot carry more than
+    ``COPYCAT_REPL_MAX_INFLIGHT / RTT`` entries/s no matter how fast the
+    leader's core is, because the in-flight cap exists to bound
+    slow-follower memory (docs/REPLICATION.md). Sharding multiplies
+    that ceiling: G groups = G independent windowed streams, with
+    leadership spread so each member sequences ~G/N of them. The A/B
+    for PERF.md round 12 is this scenario at ``--groups 4`` vs
+    ``--groups 1`` (the single-group plane, which
+    ``COPYCAT_MULTI_GROUP=0`` pins bit-identically)."""
+    import asyncio
+    import random as _random
+
+    from .client.client import RaftClient
+    from .io.local import LocalServerRegistry, LocalTransport
+    from .io.transport import Address
+    from .server.raft import LEADER, RaftServer
+
+    ClusterAdd, ClusterGet, CounterMachine = _cluster_machine_types()
+    groups = max(1, knobs.get_int("COPYCAT_BENCH_SHARDED_GROUPS"))
+    members = knobs.get_int("COPYCAT_BENCH_CLUSTER_MEMBERS")
+    n_clients = knobs.get_int("COPYCAT_BENCH_SHARDED_CLIENTS")
+    ops_per_client = knobs.get_int("COPYCAT_BENCH_SHARDED_OPS")
+    bursts = knobs.get_int("COPYCAT_BENCH_SHARDED_BURSTS")
+    n_keys = knobs.get_int("COPYCAT_BENCH_SHARDED_KEYS")
+    zipf_s = knobs.get_float("COPYCAT_BENCH_SHARDED_ZIPF")
+    delay_ms = knobs.get_float("COPYCAT_BENCH_SHARDED_DELAY_MS")
+
+    # zipfian key draw, deterministic: inverse-CDF over 1/rank^s
+    rng = _random.Random(12)
+    weights = [1.0 / (r ** zipf_s) for r in range(1, n_keys + 1)]
+    total_w = sum(weights)
+    cdf = []
+    acc = 0.0
+    for w in weights:
+        acc += w / total_w
+        cdf.append(acc)
+
+    def draw_key() -> str:
+        x = rng.random()
+        lo, hi = 0, n_keys - 1
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if cdf[mid] < x:
+                lo = mid + 1
+            else:
+                hi = mid
+        return f"user:{lo}"
+
+    async def drive() -> dict:
+        registry = LocalServerRegistry()
+        addrs = [Address("local", 17100 + i) for i in range(members)]
+        servers = [
+            RaftServer(addr, addrs,
+                       LocalTransport(registry, local_address=addr),
+                       (lambda g: CounterMachine()), groups=groups,
+                       election_timeout=0.5, heartbeat_interval=0.1,
+                       session_timeout=120.0)
+            for addr in addrs]
+        await asyncio.gather(*(s.open() for s in servers))
+        deadline = time.perf_counter() + 30
+        while time.perf_counter() < deadline:
+            led = {g.group_id for s in servers for g in s.groups
+                   if g.role == LEADER}
+            if len(led) == groups:
+                break
+            await asyncio.sleep(0.02)
+        led = {g.group_id for s in servers for g in s.groups
+               if g.role == LEADER}
+        assert len(led) == groups, \
+            f"groups without a leader: {set(range(groups)) - led}"
+        clients = [RaftClient(addrs, LocalTransport(registry),
+                              session_timeout=120.0)
+                   for _ in range(n_clients)]
+        await asyncio.gather(*(c.open() for c in clients))
+        # inject wire latency only once the cluster + sessions are up
+        nem = registry.attach_nemesis()
+        nem.set_delay(delay_ms / 1e3)
+        groups_led = {str(s.address): sum(1 for g in s.groups
+                                          if g.role == LEADER)
+                      for s in servers}
+        log(f"bench[sharded]: {members} members x {groups} groups "
+            f"(led: {groups_led}), {n_clients} clients x "
+            f"{ops_per_client} ops/burst, zipf s={zipf_s} over "
+            f"{n_keys} keys, {delay_ms} ms/leg")
+        _bench_gc_tune()
+        burst_ops = n_clients * ops_per_client
+        expected: dict[str, int] = {}
+        try:
+            # streamed micro-batches: each event-loop turn stages one
+            # CHUNK-op batch (the client's turn coalescing), many batches
+            # in flight per session up to CAP outstanding ops — the
+            # pipelined ingress keeps every group's replication window
+            # full for the whole burst. A whole-burst gather (or a
+            # half-wave gate) serializes on BATCH completion, i.e. on the
+            # hottest group's queue, and measures commit latency convoys
+            # instead of stream throughput.
+            chunk = 64
+            cap = max(chunk * 2, 768)
+
+            async def one(client: RaftClient, keys: list) -> None:
+                outstanding = 0
+                wake = asyncio.Event()
+                futs: list = []
+
+                def done(_f) -> None:
+                    nonlocal outstanding
+                    outstanding -= 1
+                    if outstanding <= cap // 2:
+                        wake.set()
+
+                i = 0
+                while i < len(keys):
+                    while outstanding >= cap:
+                        wake.clear()
+                        await wake.wait()
+                    part = keys[i:i + chunk]
+                    i += len(part)
+                    for k in part:
+                        fut = client.submit_command_nowait(
+                            ClusterAdd(key=k, delta=1))
+                        fut.add_done_callback(done)
+                        futs.append(fut)
+                    outstanding += len(part)
+                    await asyncio.sleep(0)  # turn boundary: one batch
+                await asyncio.gather(*futs)
+
+            reps = []
+            for rep in range(bursts):
+                burst_keys = []
+                for _ in range(n_clients):
+                    keys = [draw_key() for _ in range(ops_per_client)]
+                    for k in keys:
+                        expected[k] = expected.get(k, 0) + 1
+                    burst_keys.append(keys)
+                t0 = time.perf_counter()
+                await asyncio.gather(*(one(c, ks) for c, ks
+                                       in zip(clients, burst_keys)))
+                dt = time.perf_counter() - t0
+                ops = burst_ops / dt
+                reps.append(ops)
+                log(f"bench[sharded]: rep {rep}: {burst_ops} committed "
+                    f"ops in {dt:.3f}s -> {ops:,.0f} ops/sec")
+            # exactly-once spot check THROUGH the public read API:
+            # zipfian increments landed exactly once per key
+            for k in sorted(expected)[:16]:
+                v = await clients[0].submit(ClusterGet(key=k))
+                assert v == expected[k], (k, v, expected[k])
+            METRICS_SNAPSHOTS["server"] = servers[0].stats_snapshot()
+            METRICS_SNAPSHOTS["client"] = clients[0].metrics.snapshot()
+            best = max(reps)
+            # routing mix: commands per owning group, summed over every
+            # member's ingress counters
+            routing_mix = {str(g): 0 for g in range(groups)}
+            if groups > 1:
+                for s in servers:
+                    for g in range(groups):
+                        routing_mix[str(g)] += s._metrics.counter(
+                            "shard.routed", group=str(g)).value
+            per_group_commit = {
+                str(g.group_id): max(s.groups[g.group_id].commit_index
+                                     for s in servers)
+                for g in servers[0].groups}
+            return {
+                "metric": (f"sharded_committed_ops_per_sec_{members}"
+                           f"_members_{groups}_groups"),
+                "value": round(best, 1),
+                "unit": "ops/sec",
+                "vs_baseline": round(best / NORTH_STAR_OPS, 4),
+                "groups": groups,
+                "groups_led": groups_led,
+                "per_group_commit": per_group_commit,
+                "routing_mix": routing_mix,
+                "delay_ms_per_leg": delay_ms,
+                "clients": n_clients,
+                "zipf_s": zipf_s,
+                "keys": n_keys,
+                "repl_max_inflight": servers[0]._repl_max_inflight,
+                **spread(reps),
+            }
+        finally:
+            nem.heal()
+            for c in clients:
+                try:
+                    await asyncio.wait_for(c.close(), 10)
+                except Exception:
+                    pass
+            for s in servers:
+                try:
+                    await asyncio.wait_for(s.close(), 10)
+                except Exception:
+                    pass
 
     return asyncio.run(drive())
 
@@ -1562,10 +1785,17 @@ def main() -> None:
         help="log storage level for the cluster/recovery scenarios "
              "(envs COPYCAT_BENCH_CLUSTER_STORAGE / "
              "COPYCAT_BENCH_RECOVERY_STORAGE); the durability A/B knob")
+    parser.add_argument(
+        "--groups", default=None, type=int, metavar="N",
+        help="Raft groups for the sharded scenario (env "
+             "COPYCAT_BENCH_SHARDED_GROUPS); 1 = the single-group "
+             "baseline, the sharding A/B knob (docs/SHARDING.md)")
     args, _ = parser.parse_known_args()
     if args.storage:
         os.environ["COPYCAT_BENCH_CLUSTER_STORAGE"] = args.storage
         os.environ["COPYCAT_BENCH_RECOVERY_STORAGE"] = args.storage
+    if args.groups is not None:
+        os.environ["COPYCAT_BENCH_SHARDED_GROUPS"] = str(args.groups)
     # Probe the accelerator before any in-process backend use — a dead
     # tunnel otherwise hangs device enumeration forever. When every
     # probe fails (BENCH_r05: rc=2 after 5 probes, a whole round's
@@ -1602,6 +1832,8 @@ def main() -> None:
         result = run_readmix()
     elif SCENARIO == "cluster":
         result = run_cluster()
+    elif SCENARIO == "sharded":
+        result = run_sharded()
     elif SCENARIO == "recovery":
         result = run_recovery()
     elif SCENARIO == "session":
@@ -1611,7 +1843,7 @@ def main() -> None:
     else:
         raise SystemExit(
             f"unknown scenario {SCENARIO!r}; pick one of "
-            f"{['election', 'map_read', 'host', 'host_read', 'spi', 'readmix', 'cluster', 'recovery', 'session', *SUBMIT_BUILDERS]}")
+            f"{['election', 'map_read', 'host', 'host_read', 'spi', 'readmix', 'cluster', 'sharded', 'recovery', 'session', *SUBMIT_BUILDERS]}")
     if degraded:
         result["degraded"] = True
     if args.metrics_json:

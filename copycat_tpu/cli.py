@@ -143,17 +143,49 @@ def _flatten_numeric(snap: dict, prefix: str = "") -> dict:
     return out
 
 
+def _series_sort_key(key: str) -> tuple:
+    """Label-aware ordering: ``name{label}`` variants sort WITH their
+    family (name first, then label set, then any histogram sub-key), not
+    after every unlabeled name — ASCII ``{`` > letters, so a plain sort
+    scattered per-group (``group=``) series away from their siblings and
+    a multi-group watch read as disjoint families instead of one metric
+    with N labeled series."""
+    brace = key.find("{")
+    if brace < 0:
+        return (key, "", "")
+    end = key.find("}", brace)
+    if end < 0:
+        return (key, "", "")
+    return (key[:brace], key[brace + 1:end], key[end + 1:])
+
+
+def _render_header(snap: dict, lines: list, prefix: str = "") -> None:
+    """Non-numeric leaves (node/role/leader...), recursively: a
+    multi-group server's per-group role/leader strings live in nested
+    sections and used to be silently dropped from the watch frame."""
+    for k, v in snap.items():
+        if k == "_gauge_keys":
+            continue
+        if isinstance(v, dict):
+            if "count" in v and "mean" in v:
+                continue  # histogram summary: numeric, handled below
+            _render_header(v, lines, f"{prefix}{k}.")
+        elif not isinstance(v, (int, float)) or isinstance(v, bool):
+            lines.append(f"{prefix}{k}: {v}")
+
+
 def _render_watch(snap: dict, prev: dict | None, dt: float) -> str:
     """One watch frame: scalar header lines, then every numeric series
-    with its value and (from the second poll on) its delta/sec."""
+    with its value and (from the second poll on) its delta/sec. Series
+    are keyed by the FULL flattened name including labels — same-named
+    series with different labels (per-group ``group=`` series, the
+    per-consistency read mix) stay distinct, each with its own delta."""
     import time as _time
 
     lines = [f"--- {_time.strftime('%H:%M:%S')} ---"]
-    for k, v in snap.items():
-        if not isinstance(v, (dict, int, float)) or isinstance(v, bool):
-            lines.append(f"{k}: {v}")
+    _render_header(snap, lines)
     flat = _flatten_numeric(snap)
-    for key in sorted(flat):
+    for key in sorted(flat, key=_series_sort_key):
         v = flat[key]
         val = f"{v:.4f}".rstrip("0").rstrip(".") if isinstance(v, float) \
             else str(v)

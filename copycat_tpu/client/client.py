@@ -75,7 +75,11 @@ class ClientSession:
         self.id: int | None = None
         self.timeout = 0.0
         self.state = "closed"  # closed -> open -> expired/closed
-        self.event_index = 0
+        # Per-group event channels (docs/SHARDING.md): a multi-group
+        # server numbers each group's event stream independently; the
+        # single-group plane lives entirely in key 0 (the legacy scalar,
+        # via the ``event_index`` property).
+        self._event_indices: dict[int, int] = {}
         self._event_listeners: dict[str, Listeners] = {}
         self._open_listeners = Listeners()
         self._close_listeners = Listeners()
@@ -88,6 +92,14 @@ class ClientSession:
 
     def on_close(self, callback: Callable[[Any], Any]) -> Listener:
         return self._close_listeners.add(callback)
+
+    @property
+    def event_index(self) -> int:
+        return self._event_indices.get(0, 0)
+
+    @event_index.setter
+    def event_index(self, value: int) -> None:
+        self._event_indices[0] = value
 
     @property
     def is_open(self) -> bool:
@@ -155,7 +167,13 @@ class RaftClient(Managed):
         # completing first must not ack a lower seq still being retried.
         self._completed_seqs: set[int] = set()
         self._acked_command_seq = 0
-        self._index = 0  # high-water log index seen (sequential consistency)
+        # High-water applied index seen, per Raft group (sequential
+        # consistency). Single-group servers live entirely in key 0 —
+        # the legacy scalar; a multi-group server (RegisterResponse
+        # ``groups`` > 1) tags response indices with the owning group
+        # (``index * G + g``) and reads the whole dict on queries.
+        self._indices: dict[int, int] = {}
+        self._num_groups = 1
         self._keepalive: Scheduled | None = None
         # Command micro-batching: same-turn submits coalesce into ONE
         # CommandBatchRequest (flushed via call_soon at the end of the
@@ -186,7 +204,36 @@ class RaftClient(Managed):
 
     @property
     def index(self) -> int:
-        return self._index
+        return max(self._indices.values(), default=0)
+
+    def _read_index(self) -> Any:
+        """The ``index`` field for outgoing reads: the legacy scalar on a
+        single-group server, the per-group dict on a multi-group one
+        (the server extracts the owning group's entry per routed op)."""
+        if self._num_groups == 1:
+            return self._indices.get(0, 0)
+        return dict(self._indices)
+
+    def _note_index(self, value: Any) -> None:
+        """Fold a response index into the per-group high-water map:
+        scalars are group-0 (single-group) or group-tagged
+        (``idx * G + g``, multi-group); dicts are per-group maps
+        (multi-group query batches)."""
+        if not value:
+            return
+        if isinstance(value, dict):
+            for g, idx in value.items():
+                g = int(g)
+                if idx and idx > self._indices.get(g, 0):
+                    self._indices[g] = idx
+            return
+        if self._num_groups > 1:
+            g = value % self._num_groups
+            idx = value // self._num_groups
+        else:
+            g, idx = 0, value
+        if idx > self._indices.get(g, 0):
+            self._indices[g] = idx
 
     async def _do_open(self) -> None:
         self._loop = asyncio.get_running_loop()
@@ -337,17 +384,24 @@ class RaftClient(Managed):
         self._session.timeout = response.timeout or self.session_timeout
         if response.members:
             self.members = list(response.members)
+        # multi-group server (docs/SHARDING.md): switch on per-group
+        # read indices + event channels for this session's lifetime
+        self._num_groups = max(1, getattr(response, "groups", None) or 1)
         self._session._opened()
 
     async def _send_keepalive(self) -> None:
         if not self._session.is_open:
             return
         try:
+            session = self._session
+            event_index: Any = (session.event_index
+                                if self._num_groups == 1
+                                else dict(session._event_indices))
             response = await self._request(
                 msg.KeepAliveRequest(
-                    session_id=self._session.id,
+                    session_id=session.id,
                     command_seq=self._acked_command_seq,
-                    event_index=self._session.event_index),
+                    event_index=event_index),
                 # timeout/4 = the keep-alive interval: a stuck attempt
                 # yields to the next tick's re-route, and the floor
                 # keeps slow-but-healthy commits (hundreds of ms) from
@@ -362,18 +416,22 @@ class RaftClient(Managed):
 
     async def _on_publish(self, request: msg.PublishRequest) -> msg.PublishResponse:
         session = self._session
+        # the event channel is per group on a multi-group server (the
+        # response's event_index is the position on THAT group's channel)
+        g = getattr(request, "group", None) or 0
+        position = session._event_indices.get(g, 0)
         if request.session_id != session.id:
-            return msg.PublishResponse(event_index=session.event_index)
-        if request.prev_event_index != session.event_index:
+            return msg.PublishResponse(event_index=position)
+        if request.prev_event_index != position:
             # Gap or replay: report our position; the server resends from there.
-            return msg.PublishResponse(event_index=session.event_index)
+            return msg.PublishResponse(event_index=position)
         for event, message in request.events or []:
             try:
                 session._dispatch(event, message)
             except Exception:  # listener errors must not poison the channel
                 pass
-        session.event_index = request.event_index
-        return msg.PublishResponse(event_index=session.event_index)
+        session._event_indices[g] = request.event_index
+        return msg.PublishResponse(event_index=request.event_index)
 
     # -- operation submission ---------------------------------------------
 
@@ -508,8 +566,7 @@ class RaftClient(Managed):
         """Per-command success bookkeeping (the _finish tail): advance the
         sequential-read index and the contiguous completed-seq prefix the
         keep-alive acks for server response-cache pruning."""
-        if index and index > self._index:
-            self._index = index
+        self._note_index(index)
         # in-order completion (every batch entry in a healthy run): just
         # bump the prefix — the out-of-order set stays untouched/empty
         if seq == self._acked_command_seq + 1 and not self._completed_seqs:
@@ -553,7 +610,7 @@ class RaftClient(Managed):
         if len(items) == 1:
             operation, fut = items[0]
             request = msg.QueryRequest(
-                session_id=self._session.id, index=self._index,
+                session_id=self._session.id, index=self._read_index(),
                 operation=operation, consistency=consistency)
             try:
                 if round_robin:
@@ -571,7 +628,7 @@ class RaftClient(Managed):
             return
         try:
             request = msg.QueryBatchRequest(
-                session_id=self._session.id, index=self._index,
+                session_id=self._session.id, index=self._read_index(),
                 consistency=consistency,
                 operations=[op for op, _ in items])
             if round_robin:
@@ -587,8 +644,7 @@ class RaftClient(Managed):
                     fut.set_exception(e)
             return
         try:
-            if response.index:
-                self._index = max(self._index, response.index)
+            self._note_index(response.index)
             entries = response.entries or []
             for k, (operation, fut) in enumerate(items):
                 if fut.done():
@@ -626,6 +682,6 @@ class RaftClient(Managed):
         response.raise_if_error()
         if seq is not None:
             self._ack_seq(seq, response.index)
-        elif response.index:
-            self._index = max(self._index, response.index)
+        else:
+            self._note_index(getattr(response, "index", None))
         return response.result

@@ -15,6 +15,8 @@ from __future__ import annotations
 
 from typing import Any, Type, TypeVar
 
+from ..utils import knobs
+
 from ..client.client import PinnedConnectionStrategy, RaftClient
 from ..io.transport import Address, Transport
 from ..resource.resource import Resource, resource_state_machine_of
@@ -39,6 +41,34 @@ from .. import collections as _collections  # noqa: F401,E402
 from .. import coordination as _coordination  # noqa: F401,E402
 
 R = TypeVar("R", bound=Resource)
+
+
+def _manager_factory(executor: str, engine_config: Any,
+                     groups: int | None) -> tuple[Any, int]:
+    """Resolve the group count (constructor arg > COPYCAT_GROUPS, gated
+    by COPYCAT_MULTI_GROUP) and build the per-group ResourceManager
+    factory — one manager per Raft group, sharing ONE device engine so
+    every group's device-backed resources ride the same [G×P] tensor
+    plane (docs/SHARDING.md)."""
+    if groups is None:
+        groups = max(1, knobs.get_int("COPYCAT_GROUPS"))
+    if not knobs.get_bool("COPYCAT_MULTI_GROUP"):
+        groups = 1
+    if groups == 1:
+        return ResourceManager(executor=executor,
+                               engine_config=engine_config), 1
+    shared_engine = None
+    if executor == "tpu":
+        from .device_executor import DeviceEngine
+        shared_engine = DeviceEngine(engine_config)
+
+    def factory(g: int) -> ResourceManager:
+        return ResourceManager(executor=executor,
+                               engine_config=engine_config,
+                               group_id=g, num_groups=groups,
+                               engine=shared_engine)
+
+    return factory, groups
 
 
 class Atomix(Managed):
@@ -171,6 +201,13 @@ class _Builder:
         self._kwargs["stats_host"] = host
         return self
 
+    def with_groups(self, groups: int) -> "_Builder":
+        """Host N Raft groups (keyspace shards) behind this server —
+        docs/SHARDING.md. Default: ``COPYCAT_GROUPS`` (1). Must be
+        uniform across the cluster."""
+        self._kwargs["groups"] = groups
+        return self
+
     def with_executor(self, executor: str,
                       engine_config: Any | None = None) -> "_Builder":
         """Select the resource executor: ``"cpu"`` (default) or ``"tpu"``
@@ -193,6 +230,7 @@ class _Builder:
             kwargs.pop("engine_config", None)
             kwargs.pop("stats_port", None)
             kwargs.pop("stats_host", None)
+            kwargs.pop("groups", None)
         return self._cls(**kwargs)
 
 
@@ -225,13 +263,14 @@ class AtomixReplica(Atomix):
         engine_config: Any | None = None,
         stats_port: int | None = None,
         stats_host: str = "127.0.0.1",
+        groups: int | None = None,
     ) -> None:
+        machine, groups = _manager_factory(executor, engine_config, groups)
         self.server = RaftServer(
-            address, members, transport,
-            ResourceManager(executor=executor, engine_config=engine_config),
+            address, members, transport, machine,
             storage=storage,
             election_timeout=election_timeout, heartbeat_interval=heartbeat_interval,
-            session_timeout=session_timeout)
+            session_timeout=session_timeout, groups=groups)
         client = RaftClient(
             list(members), transport, session_timeout=session_timeout,
             connection_strategy=PinnedConnectionStrategy(address))
@@ -291,14 +330,15 @@ class AtomixServer(Managed):
         engine_config: Any | None = None,
         stats_port: int | None = None,
         stats_host: str = "127.0.0.1",
+        groups: int | None = None,
     ) -> None:
         super().__init__()
+        machine, groups = _manager_factory(executor, engine_config, groups)
         self.server = RaftServer(
-            address, members, transport,
-            ResourceManager(executor=executor, engine_config=engine_config),
+            address, members, transport, machine,
             storage=storage,
             election_timeout=election_timeout, heartbeat_interval=heartbeat_interval,
-            session_timeout=session_timeout)
+            session_timeout=session_timeout, groups=groups)
         self.address = address
         self._stats_port = stats_port
         self._stats_host = stats_host

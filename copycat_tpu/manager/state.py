@@ -16,6 +16,7 @@ Session expiry/close fans out to every resource the session touched
 from __future__ import annotations
 
 import logging
+import zlib
 from typing import Any, Callable
 
 from ..server.session import ServerSession, SessionState
@@ -157,7 +158,9 @@ class ResourceManager(StateMachine):
     """
 
     def __init__(self, executor: str = "cpu",
-                 engine_config: Any | None = None) -> None:
+                 engine_config: Any | None = None,
+                 group_id: int = 0, num_groups: int = 1,
+                 engine: Any = None) -> None:
         super().__init__()
         if executor not in ("cpu", "tpu"):
             raise ValueError(f"unknown executor {executor!r}")
@@ -165,12 +168,40 @@ class ResourceManager(StateMachine):
         self.resources: dict[int, ResourceHolder] = {}
         self.instances: dict[int, InstanceHolder] = {}
         self.executor_kind = executor
-        self._engine: Any = None
+        # Keyspace sharding (docs/SHARDING.md): on a multi-group server
+        # each group hosts its own manager; resource/instance ids are
+        # stamped ``index * num_groups + group_id`` so ids are globally
+        # unique AND self-routing (``id % num_groups`` = owning group).
+        # With num_groups == 1 the stamp is the identity — ids (and the
+        # whole manager) are bit-identical to the unsharded plane.
+        self.group_id = group_id
+        self.num_groups = max(1, num_groups)
+        # ``engine`` shares ONE DeviceEngine across the per-group
+        # managers: every group's device-backed resources live in rows
+        # of the same [G×P] tensor plane and compile once.
+        self._engine: Any = engine
         self._engine_config = engine_config
         # Catalog counters feed inline; point-in-time gauges refresh in
         # stats() (the server's stats_snapshot pulls it — see
         # docs/OBSERVABILITY.md).
         self.metrics = MetricsRegistry()
+
+    @classmethod
+    def route_group(cls, operation: Any, groups: int) -> int:
+        """Hash routing over the keyspace (docs/SHARDING.md): catalog
+        ops route by a stable CRC of the resource key; instance ops are
+        self-routing (ids carry their group residue). Deterministic
+        across members, restarts, and processes — the stability contract
+        tests/test_sharding.py pins."""
+        t = type(operation)
+        if t in (InstanceCommand, InstanceQuery):
+            return operation.resource % groups
+        if t is DeleteResource:
+            return operation.instance_id % groups
+        key = getattr(operation, "key", None)
+        if isinstance(key, str):  # GetResource / CreateResource / Exists
+            return zlib.crc32(key.encode()) % groups
+        return 0
 
     @property
     def device_engine(self) -> Any:
@@ -326,7 +357,7 @@ class ResourceManager(StateMachine):
                     f"resource '{key}' exists with type "
                     f"{holder.machine_cls.__name__}, not {machine_cls.__name__}")
             return holder
-        resource_id = commit.index
+        resource_id = commit.index * self.num_groups + self.group_id
         self.keys[key] = resource_id
         machine = self._instantiate_machine(machine_cls)
         executor = ManagerResourceExecutor(self.executor, resource_id, key)
@@ -352,7 +383,7 @@ class ResourceManager(StateMachine):
         return machine_cls()
 
     def _create_instance(self, commit: Commit, holder: ResourceHolder) -> InstanceHolder:
-        instance_id = commit.index
+        instance_id = commit.index * self.num_groups + self.group_id
         session = ManagedResourceSession(instance_id, commit.session)
         instance = InstanceHolder(instance_id, holder, session, commit.session)
         self.instances[instance_id] = instance

@@ -104,8 +104,12 @@ class RegisterRequest(Message):
 
 @serialize_with(201)
 class RegisterResponse(Response):
-    # session_id doubles as the registering entry's log index.
-    _fields = ("error", "error_detail", "leader", "session_id", "timeout", "members")
+    # session_id doubles as the registering entry's log index (stamped
+    # with the group count on a multi-group server — docs/SHARDING.md).
+    # groups: the server's Raft group count; >1 switches the client into
+    # multi-group mode (per-group read indices + event channels).
+    _fields = ("error", "error_detail", "leader", "session_id", "timeout",
+               "members", "groups")
 
 
 @serialize_with(202)
@@ -200,9 +204,15 @@ class PublishRequest(Message):
     ``events`` is a list of (event_name, payload) applied at ``index``;
     ``prev_event_index`` lets the client detect gaps and request a replay via
     keep-alive acks.
+
+    ``group`` scopes the event channel on a multi-group server: each
+    group's replica of a session numbers its own event stream, and the
+    client tracks ``event_index`` per group (None = single-group, the
+    legacy scalar channel).
     """
 
-    _fields = ("session_id", "event_index", "prev_event_index", "events")
+    _fields = ("session_id", "event_index", "prev_event_index", "events",
+               "group")
 
 
 @serialize_with(211)
@@ -217,7 +227,11 @@ class PublishResponse(Response):
 
 @serialize_with(216)
 class VoteRequest(Message):
-    _fields = ("term", "candidate", "last_log_index", "last_log_term")
+    # group: the Raft group this RPC belongs to on a multi-group server
+    # (docs/SHARDING.md); None = the single-group plane, byte-identical
+    # to the pre-sharding wire shape. Same field on Append/Install.
+    _fields = ("term", "candidate", "last_log_index", "last_log_term",
+               "group")
 
 
 @serialize_with(217)
@@ -234,7 +248,7 @@ class AppendRequest(Message):
     # gap-fills those slots and never applies them, mirroring the reference's
     # replay-after-compaction semantics.
     _fields = ("term", "leader", "prev_index", "prev_term", "entries", "commit_index",
-               "global_index", "fill_to")
+               "global_index", "fill_to", "group")
 
 
 @serialize_with(219)
@@ -260,7 +274,7 @@ class InstallRequest(Message):
     """
 
     _fields = ("term", "leader", "index", "snap_term", "total", "offset",
-               "data", "done")
+               "data", "done", "group")
 
 
 @serialize_with(213)
@@ -272,6 +286,27 @@ class InstallResponse(Response):
     # last_index: the follower's log tail after a completed install.
     _fields = ("error", "error_detail", "term", "success", "offset",
                "last_index")
+
+
+@serialize_with(228)
+class ProxyRequest(Message):
+    """Server -> server ingress forwarding on a multi-group server
+    (docs/SHARDING.md): the member holding a client's connection routes
+    each staged sub-request to the owning group's leader. ``kind`` names
+    the staging entry point (``register`` / ``keepalive`` /
+    ``unregister`` / ``commands`` / ``query``); ``payload`` is the
+    kind-specific tuple. Responses travel as :class:`ProxyResponse` with
+    the kind-specific ``result`` payload, plus the uniform
+    error/leader-hint fields so the ingress can retry toward the
+    group's current leader.
+    """
+
+    _fields = ("group", "kind", "payload")
+
+
+@serialize_with(229)
+class ProxyResponse(Response):
+    _fields = ("error", "error_detail", "leader", "result")
 
 
 @serialize_with(220)

@@ -268,7 +268,12 @@ class NoOpEntry(Entry):
 
 @serialize_with(231)
 class RegisterEntry(Entry):
-    _fields = ("client_id", "timeout")
+    # session_id: None on the single-group plane (the id IS the entry's
+    # log index, the reference rule). On a multi-group server the
+    # id-allocating group 0 leaves it None and derives the global id at
+    # apply; the fan-out entries appended to groups 1..G-1 carry that id
+    # explicitly so every group's replica shares it (docs/SHARDING.md).
+    _fields = ("client_id", "timeout", "session_id")
 
 
 @serialize_with(232)

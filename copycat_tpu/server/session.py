@@ -60,6 +60,11 @@ class ServerSession:
         # over reconnects) park in pending_ops until the gap fills.
         self.next_append_seq = 0  # 0 = uninitialized on this leader
         self.pending_ops: dict[int, Any] = {}  # seq -> operation awaiting append
+        # Multi-group block staging (RaftGroup.command_block): the commit
+        # future of the newest append block for this session in this
+        # group, so a resent sub-block racing its first attempt can ride
+        # the pending commit instead of mis-reading "pruned".
+        self.last_block_future: Any = None
 
         # --- apply-time scratch ---
         self._current_events: list[tuple[str, Any]] = []

@@ -179,6 +179,19 @@ class StateMachine:
     def configure(self, executor: StateMachineExecutor) -> None:
         """Hook for explicit operation registration."""
 
+    # -- keyspace sharding hook (docs/SHARDING.md) ------------------------
+
+    @classmethod
+    def route_group(cls, operation: Any, groups: int) -> int:
+        """The Raft group owning ``operation`` on a multi-group server.
+
+        Must be a pure function of the operation and the group count —
+        identical on every member and across restarts (the hash-routing
+        stability contract). The default pins everything to group 0;
+        machines that shard (ResourceManager, bench fixtures) override
+        with a stable key hash."""
+        return 0
+
     def _auto_register(self, executor: StateMachineExecutor) -> None:
         # The (method name -> Commit[Op] type) table is a pure function of
         # the CLASS; the signature/type-hint introspection below is

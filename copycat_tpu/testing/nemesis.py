@@ -106,10 +106,11 @@ async def crash_server(server) -> None:
     server._open = False  # Managed bookkeeping: a crashed server is closed
     server._cancel_timers()
     server._stop_replication()
-    for fut in server._commit_futures.values():
-        if not fut.done():
-            fut.cancel()
-    server._commit_futures.clear()
+    for group in getattr(server, "groups", None) or (server,):
+        for fut in group._commit_futures.values():
+            if not fut.done():
+                fut.cancel()
+        group._commit_futures.clear()
     await server._server.close()
     await server._client.close()
     server._peer_connections.clear()

@@ -83,6 +83,12 @@ def _knob(name: str, kind: str, default: Any, doc: str, *, section: str,
 
 
 # --- server planes ---------------------------------------------------------
+_knob("COPYCAT_GROUPS", "int", 1,
+      "Raft groups per server (keyspace shards, docs/SHARDING.md); >1 "
+      "spreads leadership and routes resources by hash", section="server")
+_knob("COPYCAT_MULTI_GROUP", "bool", True,
+      "`0` forces the single-group plane regardless of `COPYCAT_GROUPS` "
+      "(the sharding A/B)", section="server")
 _knob("COPYCAT_SERVER_VECTOR_PUMP", "bool", True,
       "`0` restores the per-op command apply lane (the spi A/B)",
       section="server")
@@ -257,6 +263,28 @@ _knob("COPYCAT_BENCH_CLUSTER_BURSTS", "int", 5,
       "bursts (best-of) in the cluster scenario", section="bench")
 _knob("COPYCAT_BENCH_CLUSTER_DELAY_MS", "float", 2.0,
       "nemesis wire latency per leg, ms", section="bench")
+_knob("COPYCAT_BENCH_SHARDED_GROUPS", "int", 4,
+      "Raft groups in the sharded scenario (1 = the single-group A/B "
+      "baseline)", section="bench")
+_knob("COPYCAT_BENCH_SHARDED_CLIENTS", "int", 12,
+      "concurrent public-API clients in the sharded scenario",
+      section="bench")
+_knob("COPYCAT_BENCH_SHARDED_OPS", "int", 1200,
+      "commands per client per burst in the sharded scenario",
+      section="bench")
+_knob("COPYCAT_BENCH_SHARDED_BURSTS", "int", 5,
+      "measured bursts (best-of) in the sharded scenario",
+      section="bench")
+_knob("COPYCAT_BENCH_SHARDED_KEYS", "int", 1024,
+      "zipfian keyspace size in the sharded scenario", section="bench")
+_knob("COPYCAT_BENCH_SHARDED_ZIPF", "float", 0.9,
+      "zipf skew exponent for the sharded scenario's key draw",
+      section="bench")
+_knob("COPYCAT_BENCH_SHARDED_DELAY_MS", "float", 100.0,
+      "nemesis wire latency per leg, ms (cross-region shape: the "
+      "bounded replication window caps a single ordered log at "
+      "max-inflight/RTT — the cap sharding multiplies)",
+      section="bench")
 _knob("COPYCAT_BENCH_RECOVERY_OPS", "int", 6000,
       "committed entries before the recovery scenario's catch-up",
       section="bench")

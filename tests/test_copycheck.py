@@ -171,6 +171,68 @@ def test_await_tear_accepts_epoch_guard():
     assert check_await_tear(_tree(GUARDED), "server/raft.py") == []
 
 
+# The multi-raft refactor moved protected fields from ``self`` onto the
+# group-state object (server/raft_group.py; server code reaches them
+# through aliases like ``grp``): the rule keys events by (base, field),
+# so a torn write through an alias still fires, a guard on the SAME base
+# discharges it, and a guard on a DIFFERENT base does not.
+GROUP_TEAR = """
+    class RaftServer:
+        async def transition(self, peer):
+            grp = self.groups[0]
+            term = grp.term
+            response = await self.send(peer, term)
+            grp.term = response.term
+"""
+
+GROUP_GUARDED = """
+    class RaftServer:
+        async def transition(self, peer):
+            grp = self.groups[0]
+            term = grp.term
+            response = await self.send(peer, term)
+            if grp.term != term:
+                return
+            grp.term = response.term
+"""
+
+GROUP_CROSS_BASE_GUARD = """
+    class RaftServer:
+        async def transition(self, peer, other):
+            grp = self.groups[0]
+            term = grp.term
+            response = await self.send(peer, term)
+            if other.term != term:
+                return
+            grp.term = response.term
+"""
+
+
+def test_await_tear_flags_group_state_write_after_await():
+    found = check_await_tear(_tree(GROUP_TEAR), "server/raft_group.py")
+    assert len(found) == 1
+    assert "grp.term" in found[0].message
+
+
+def test_await_tear_accepts_group_state_epoch_guard():
+    assert check_await_tear(_tree(GROUP_GUARDED),
+                            "server/raft_group.py") == []
+
+
+def test_await_tear_guard_must_reread_the_same_base():
+    found = check_await_tear(_tree(GROUP_CROSS_BASE_GUARD),
+                             "server/raft_group.py")
+    assert len(found) == 1
+    assert "grp.term" in found[0].message
+
+
+def test_await_tear_scope_covers_raft_group_file():
+    # basename scope: the refactored per-group core is checked, other
+    # modules are not
+    assert check_await_tear(_tree(GROUP_TEAR), "server/raft_group.py")
+    assert check_await_tear(_tree(GROUP_TEAR), "client/client.py") == []
+
+
 def test_await_tear_accepts_role_guard_and_flags_log_tail():
     role_guard = _tree("""
         class RaftServer:

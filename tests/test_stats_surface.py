@@ -226,3 +226,41 @@ def test_cli_stats_unreachable(capsys):
                                    "what": "stats"})())
     assert rc == 1
     assert "--stats-port" in capsys.readouterr().err
+
+
+def test_watch_renderer_keeps_labeled_series_distinct():
+    """The --watch delta view is label-aware (the multi-group fix): two
+    series sharing a name but differing in labels (per-group `group=`
+    series, the per-consistency read mix) render as separate lines with
+    INDEPENDENT deltas, sorted with their family (a plain sort put
+    `name{...}` after every unlabeled name — ASCII `{` > letters)."""
+    snap = {"node": "n", "raft": {
+        "raft_term{group=0}": 3, "raft_term{group=1}": 4,
+        "query_reads{consistency=causal}": 5, "query_windows": 2}}
+    prev = cli._flatten_numeric(snap)
+    assert "raft.raft_term{group=0}" in prev
+    assert "raft.raft_term{group=1}" in prev
+    snap["raft"]["raft_term{group=1}"] = 6
+    frame = cli._render_watch(snap, prev, 1.0)
+    lines = [ln for ln in frame.splitlines() if "raft_term" in ln]
+    assert len(lines) == 2
+    g0 = next(ln for ln in lines if "{group=0}" in ln)
+    g1 = next(ln for ln in lines if "{group=1}" in ln)
+    assert "+2.0/s" in g1 and "/s" not in g0
+    # family-sorted: the labeled read-mix series sits before
+    # query_windows, not after it
+    keys = [ln.split()[0] for ln in frame.splitlines() if "query" in ln]
+    assert keys == ["raft.query_reads{consistency=causal}",
+                    "raft.query_windows"]
+
+
+def test_watch_renderer_shows_nested_group_strings():
+    """Per-group role/leader strings (nested sections) appear in the
+    header instead of being dropped."""
+    snap = {"node": "n", "role": "follower",
+            "groups": {"0": {"role": "leader", "leader": "l:1",
+                             "commit_index": 5}}}
+    frame = cli._render_watch(snap, None, 0.0)
+    assert "groups.0.role: leader" in frame
+    assert "groups.0.leader: l:1" in frame
+    assert "groups.0.commit_index" in frame
