@@ -1361,6 +1361,65 @@ def run_sharded() -> dict:
                 reps.append(ops)
                 log(f"bench[sharded]: rep {rep}: {burst_ops} committed "
                     f"ops in {dt:.3f}s -> {ops:,.0f} ops/sec")
+            # causal-tracing wave (COPYCAT_BENCH_SHARDED_TRACE=1): one
+            # traced micro-batch AFTER the timed bursts (the perf
+            # numbers stay untraced) whose keys cover every group in a
+            # single event-loop turn — one CommandBatchRequest fanning
+            # out across group leaders, assembled into the cross-member
+            # waterfall for the --metrics-json artifact.
+            trace_section = None
+            if knobs.get_bool("COPYCAT_BENCH_SHARDED_TRACE"):
+                import zlib
+
+                from .utils import tracing as _tracing
+
+                _tracing.TRACER.clear()
+                _tracing.enable()
+                try:
+                    cover: dict[int, str] = {}
+                    i = 0
+                    while len(cover) < groups:
+                        k = f"trace:{i}"
+                        cover.setdefault(zlib.crc32(k.encode()) % groups, k)
+                        i += 1
+                    tkeys = [cover[g] for g in sorted(cover)]
+                    for k in tkeys:
+                        expected[k] = expected.get(k, 0) + 1
+                    await asyncio.gather(*(
+                        clients[0].submit_command_nowait(
+                            ClusterAdd(key=k, delta=1)) for k in tkeys))
+                finally:
+                    _tracing.disable()
+                best_asm = None
+                for tid, spans in _tracing.TRACER.traces().items():
+                    if not any(s.name == "client.submit" for s in spans):
+                        continue
+                    asm = _tracing.assemble_trace(tid, {"ring": spans})
+                    if best_asm is None or (len(asm["members"])
+                                            > len(best_asm["members"])):
+                        best_asm = asm
+                assert best_asm is not None, "traced wave lost its trace"
+                trace_section = {
+                    "trace_id": best_asm["trace"],
+                    "e2e_ms": best_asm["e2e_ms"],
+                    "critical_path_ms": best_asm["critical_path_ms"],
+                    "incomplete": best_asm["incomplete"],
+                    "members": [m for m in best_asm["members"]
+                                if m != "client"],
+                    "phases": sorted({s["name"]
+                                      for s in best_asm["spans"]}),
+                    "waterfall": _tracing.render_waterfall(best_asm),
+                }
+                log("bench[sharded]: traced waterfall\n"
+                    + trace_section["waterfall"])
+                # the ingress member's snapshot carries the
+                # latency.ingress_queue_ms / proxy_hop_ms phases the CI
+                # smoke asserts (metrics.server below is member 0, which
+                # may not have been the traced client's ingress)
+                ingress_addr = clients[0]._connected_to
+                ingress = next((s for s in servers
+                                if s.address == ingress_addr), servers[0])
+                METRICS_SNAPSHOTS["ingress"] = ingress.stats_snapshot()
             # exactly-once spot check THROUGH the public read API:
             # zipfian increments landed exactly once per key
             for k in sorted(expected)[:16]:
@@ -1381,11 +1440,14 @@ def run_sharded() -> dict:
                 str(g.group_id): max(s.groups[g.group_id].commit_index
                                      for s in servers)
                 for g in servers[0].groups}
+            result_extra = ({"trace": trace_section}
+                            if trace_section is not None else {})
             return {
                 "metric": (f"sharded_committed_ops_per_sec_{members}"
                            f"_members_{groups}_groups"),
                 "value": round(best, 1),
                 "unit": "ops/sec",
+                **result_extra,
                 "vs_baseline": round(best / NORTH_STAR_OPS, 4),
                 "groups": groups,
                 "groups_led": groups_led,

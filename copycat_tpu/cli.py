@@ -198,6 +198,38 @@ def _render_watch(snap: dict, prev: dict | None, dt: float) -> str:
     return "\n".join(lines)
 
 
+def _render_traces_watch(body: bytes, prev_ids: set | None,
+                         slowest: int) -> tuple[str, set]:
+    """One ``--watch --what traces`` frame: the slowest-N traces with a
+    per-phase breakdown (phase lines keyed ``name{group=,member=}`` and
+    ordered with the label-aware family sort, so per-group phases sit
+    with their family), NEW-marking traces that appeared since the last
+    poll."""
+    import time as _time
+
+    traces = json.loads(body)
+    ids = {t["trace"] for t in traces}
+    lines = [f"--- {_time.strftime('%H:%M:%S')} slowest "
+             f"{min(slowest, len(traces))}/{len(traces)} traces ---"]
+    for t in traces[:slowest]:
+        new = "  NEW" if prev_ids is not None and t["trace"] not in prev_ids \
+            else ""
+        lines.append(f"trace {t['trace']}  total {t['total_ms']:.3f} ms"
+                     f"{new}")
+        phases: dict[str, float] = {}
+        for s in t.get("spans", ()):
+            labels = []
+            if s.get("group") is not None:
+                labels.append(f"group={s['group']}")
+            if s.get("member") is not None:
+                labels.append(f"member={s['member']}")
+            key = s["name"] + (f"{{{','.join(labels)}}}" if labels else "")
+            phases[key] = phases.get(key, 0.0) + s.get("duration_ms", 0.0)
+        for key in sorted(phases, key=_series_sort_key):
+            lines.append(f"  {key:<58} {phases[key]:>12.3f} ms")
+    return "\n".join(lines), ids
+
+
 def _stats(args: argparse.Namespace) -> int:
     import time
 
@@ -206,10 +238,13 @@ def _stats(args: argparse.Namespace) -> int:
     # ``all`` renders every surface in one shot (JSON snapshot first — it
     # carries all registries, the read-lane family included — then the
     # Prometheus text and the flight ring); its watch mode polls /stats,
-    # whose delta renderer already covers every numeric series.
+    # whose delta renderer already covers every numeric series. Watch
+    # mode for ``traces`` polls the JSON route (the slowest-N delta
+    # renderer's shape) where one-shot mode prints the text rendering.
+    watch = getattr(args, "watch", None)
     path = {"stats": "/stats", "metrics": "/metrics",
-            "traces": "/traces.txt", "flight": "/flight.txt",
-            "all": "/stats"}[args.what]
+            "traces": "/traces" if watch is not None else "/traces.txt",
+            "flight": "/flight.txt", "all": "/stats"}[args.what]
 
     def fetch(p: str = path) -> bytes | None:
         try:
@@ -220,7 +255,6 @@ def _stats(args: argparse.Namespace) -> int:
                   file=sys.stderr)
             return None
 
-    watch = getattr(args, "watch", None)
     if watch is None:
         body = fetch()
         if body is None:
@@ -243,8 +277,11 @@ def _stats(args: argparse.Namespace) -> int:
 
     # --watch N: poll + re-render every N seconds; in stats mode each
     # numeric series shows its delta/sec vs the previous poll (how fast
-    # is device.elections_started actually moving?). Ctrl-C exits.
+    # is device.elections_started actually moving?); in traces mode the
+    # slowest-N traces with per-phase breakdowns, NEW-marking traces
+    # that landed since the last poll. Ctrl-C exits.
     prev: dict | None = None
+    prev_ids: set | None = None
     prev_t = 0.0
     failures = 0
     try:
@@ -263,6 +300,10 @@ def _stats(args: argparse.Namespace) -> int:
                           flush=True)
                     prev = _flatten_numeric(snap)
                     prev_t = now
+                elif args.what == "traces":
+                    frame, prev_ids = _render_traces_watch(
+                        body, prev_ids, getattr(args, "slowest", 8))
+                    print(frame, flush=True)
                 else:
                     print(f"--- {time.strftime('%H:%M:%S')} "
                           f"{args.address}{path} ---", flush=True)
@@ -272,11 +313,76 @@ def _stats(args: argparse.Namespace) -> int:
         return 0
 
 
+def _trace(args: argparse.Namespace) -> int:
+    """``copycat-tpu trace addr [addr...]``: assemble cross-member
+    causal waterfalls (docs/OBSERVABILITY.md "Cluster-wide causal
+    tracing"). The FIRST address seeds the slowest-N trace ids (its
+    ``/traces`` ring); every given address is then asked for its local
+    spans of each id (``/traces/<id>``) and the merged timeline is
+    rendered with the critical path highlighted. A member that cannot
+    be reached marks the assembly ``incomplete`` — partial waterfalls
+    are rendered, never dropped."""
+    from .server.stats import fetch_stats
+    from .utils.tracing import assemble_trace, render_waterfall
+
+    async def fetch(address: str, path: str) -> bytes | None:
+        try:
+            return await fetch_stats(address, path)
+        except (OSError, RuntimeError, asyncio.TimeoutError):
+            return None
+
+    async def collect():
+        seed = await fetch(args.addresses[0], "/traces")
+        if seed is None:
+            return None
+        slowest = json.loads(seed)[:args.slowest]
+        # genuinely fan out: every member's /traces/<id> for every
+        # slowest id in one gather — a slow/hung member costs one
+        # timeout, not one timeout per serial fetch
+        ids = [entry["trace"] for entry in slowest]
+        bodies = await asyncio.gather(*(
+            fetch(address, f"/traces/{trace_id}")
+            for trace_id in ids for address in args.addresses))
+        n = len(args.addresses)
+        return [(trace_id, bodies[k * n:(k + 1) * n])
+                for k, trace_id in enumerate(ids)]
+
+    collected = asyncio.run(collect())
+    if collected is None:
+        print(f"copycat-tpu trace: cannot read {args.addresses[0]}/traces"
+              f"\n(is the server running with --stats-port?)",
+              file=sys.stderr)
+        return 1
+    if not collected:
+        print("(no traces recorded — run a traced client: COPYCAT_TRACE=1)")
+        return 0
+    assemblies = []
+    for trace_id, bodies in collected:
+        spans_by_member: dict = {}
+        failed: list = []
+        for address, body in zip(args.addresses, bodies):
+            if body is None:
+                failed.append(address)
+                continue
+            local = json.loads(body)
+            spans_by_member.setdefault(
+                local.get("member", address), []).extend(local["spans"])
+        assemblies.append(assemble_trace(trace_id, spans_by_member,
+                                         failed_members=failed))
+    if args.json:
+        print(json.dumps(assemblies, indent=2))
+        return 0
+    for assembly in assemblies:
+        print(render_waterfall(assembly))
+        print()
+    return 0
+
+
 def main(argv: list[str] | None = None) -> None:
     """``copycat-tpu <verb>``: ``stats <host:port>`` reads a running
-    server's observability surface; ``serve`` is ``copycat-server``;
-    ``lint`` runs the copycheck static-analysis suite (jax-free —
-    docs/ANALYSIS.md)."""
+    server's observability surface; ``trace`` assembles cross-member
+    causal waterfalls; ``serve`` is ``copycat-server``; ``lint`` runs
+    the copycheck static-analysis suite (jax-free — docs/ANALYSIS.md)."""
     raw = sys.argv[1:] if argv is None else argv
     if raw and raw[0] == "lint":
         # copycheck owns its own argparse surface (docs/ANALYSIS.md);
@@ -303,7 +409,26 @@ def main(argv: list[str] | None = None) -> None:
     stats.add_argument("--watch", type=float, default=None, metavar="N",
                        help="poll mode: re-render every N seconds; the "
                             "JSON snapshot view shows delta/sec per "
-                            "numeric series between polls (Ctrl-C exits)")
+                            "numeric series between polls, the traces "
+                            "view the slowest-N traces with per-phase "
+                            "breakdowns and NEW markers (Ctrl-C exits)")
+    stats.add_argument("--slowest", type=int, default=8, metavar="N",
+                       help="traces watch mode: how many of the slowest "
+                            "traces to render per poll (default 8)")
+
+    trace = sub.add_parser(
+        "trace", help="assemble cross-member causal waterfalls from "
+                      "every member's stats listener")
+    trace.add_argument("addresses", nargs="+", metavar="host:port",
+                       help="stats endpoints of the members to query; "
+                            "the first seeds the slowest-trace list, "
+                            "unreachable members mark assemblies "
+                            "incomplete (never dropped)")
+    trace.add_argument("--slowest", type=int, default=3, metavar="N",
+                       help="assemble the N slowest traces (default 3)")
+    trace.add_argument("--json", action="store_true",
+                       help="emit the assemblies as JSON instead of "
+                            "the rendered waterfalls")
 
     serve = sub.add_parser("serve", help="run a standalone server node")
     serve.add_argument("rest", nargs=argparse.REMAINDER)
@@ -318,5 +443,7 @@ def main(argv: list[str] | None = None) -> None:
     args = parser.parse_args(raw)
     if args.verb == "stats":
         raise SystemExit(_stats(args))
+    if args.verb == "trace":
+        raise SystemExit(_trace(args))
     if args.verb == "serve":
         server(args.rest)

@@ -416,6 +416,8 @@ class RaftClient(Managed):
 
     async def _on_publish(self, request: msg.PublishRequest) -> msg.PublishResponse:
         session = self._session
+        trace = getattr(request, "trace", None)
+        t0 = time.perf_counter() if trace is not None else 0.0
         # the event channel is per group on a multi-group server (the
         # response's event_index is the position on THAT group's channel)
         g = getattr(request, "group", None) or 0
@@ -431,6 +433,11 @@ class RaftClient(Managed):
             except Exception:  # listener errors must not poison the channel
                 pass
         session._event_indices[g] = request.event_index
+        if trace is not None:
+            # traced event delivery: receipt + listener dispatch on the
+            # originating causal timeline (member tag "client")
+            TRACER.span(trace, "client.event", t0, time.perf_counter(),
+                        group=g, n=len(request.events or ()))
         return msg.PublishResponse(event_index=request.event_index)
 
     # -- operation submission ---------------------------------------------

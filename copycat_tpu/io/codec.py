@@ -69,7 +69,7 @@ def _configure(mod: Any) -> None:
         return obj, buf._pos
 
     mod.configure(s._ID_BY_TYPE, s._TYPE_REGISTRY, s._CODEC_FIELDS,
-                  encode_body, decode_body)
+                  encode_body, decode_body, s._CODEC_OPTIONAL)
 
 
 def codec() -> Any:

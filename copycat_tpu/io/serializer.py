@@ -54,6 +54,12 @@ _ID_BY_TYPE: dict[type, int] = {}
 #: (io/codec.py) serializes generic classes entirely in C; None means it
 #: calls back into the class's custom write_object/read_object.
 _CODEC_FIELDS: dict[int, tuple | None] = {}
+#: type_id -> count of TRAILING fields that are wire-optional (a
+#: trailing None run is omitted when writing; a reader at end-of-buffer
+#: fills them with None). Mirrors ``Message._optional`` so the C walk
+#: and the Python walk stay byte-identical; only meaningful for
+#: top-level RPC messages (see protocol/messages.py).
+_CODEC_OPTIONAL: dict[int, int] = {}
 
 
 def _generic_fields(cls: type) -> tuple | None:
@@ -79,7 +85,10 @@ def serialize_with(type_id: int) -> Callable[[type], type]:
             raise ValueError(f"serialization id {type_id} already bound to {check!r}")
         _TYPE_REGISTRY[type_id] = cls
         _ID_BY_TYPE[cls] = type_id
-        _CODEC_FIELDS[type_id] = _generic_fields(cls)
+        fields = _generic_fields(cls)
+        _CODEC_FIELDS[type_id] = fields
+        _CODEC_OPTIONAL[type_id] = (
+            int(getattr(cls, "_optional", 0)) if fields is not None else 0)
         return cls
 
     return register

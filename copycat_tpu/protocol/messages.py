@@ -42,9 +42,21 @@ class Message:
     assignments instead of a per-field ``kwargs.get`` + ``setattr``
     loop. Messages are constructed per op on the session hot path, so
     the generic loop was a measured share of the SPI plane's per-op
-    cost (PERF.md round 6)."""
+    cost (PERF.md round 6).
+
+    ``_optional`` marks that many TRAILING fields as wire-optional: a
+    trailing run of ``None`` values is omitted from the encoding, and a
+    reader that runs out of buffer fills the rest with ``None``. That
+    makes a new trailing field (the tracing plane's ``trace``) free on
+    the wire when unused — frames stay byte-identical to the
+    pre-tracing schema (the golden differential in
+    tests/test_trace_plane.py). The omission is only decodable when the
+    message ends its buffer, so optional fields are restricted to
+    TOP-LEVEL RPC messages (one frame = one message); never mark a
+    message that nests inside another object graph."""
 
     _fields: ClassVar[tuple[str, ...]] = ()
+    _optional: ClassVar[int] = 0
 
     def __init__(self, **kwargs: Any) -> None:
         for name in self._fields:
@@ -58,12 +70,23 @@ class Message:
         compile_field_init(cls, fields)
 
     def write_object(self, buf: BufferOutput, serializer: Serializer) -> None:
-        for name in self._fields:
+        fields = self._fields
+        n = len(fields)
+        opt = self._optional
+        while opt and getattr(self, fields[n - 1]) is None:
+            n -= 1
+            opt -= 1
+        for name in fields[:n]:
             serializer.write_object(getattr(self, name), buf)
 
     def read_object(self, buf: BufferInput, serializer: Serializer) -> None:
-        for name in self._fields:
-            setattr(self, name, serializer.read_object(buf))
+        fields = self._fields
+        required = len(fields) - self._optional
+        for i, name in enumerate(fields):
+            if i >= required and buf.remaining == 0:
+                setattr(self, name, None)
+            else:
+                setattr(self, name, serializer.read_object(buf))
 
     def __repr__(self) -> str:
         inner = ", ".join(f"{n}={getattr(self, n)!r}" for n in self._fields)
@@ -209,10 +232,15 @@ class PublishRequest(Message):
     group's replica of a session numbers its own event stream, and the
     client tracks ``event_index`` per group (None = single-group, the
     legacy scalar channel).
+
+    ``trace`` (optional trailing, omitted when None): the trace id of
+    the applied command whose events this push delivers, so the client
+    records a ``client.event`` span on the same causal timeline.
     """
 
     _fields = ("session_id", "event_index", "prev_event_index", "events",
-               "group")
+               "group", "trace")
+    _optional = 1
 
 
 @serialize_with(211)
@@ -247,8 +275,15 @@ class AppendRequest(Message):
     # the window were cleaned+compacted (effects superseded) — the follower
     # gap-fills those slots and never applies them, mirroring the reference's
     # replay-after-compaction semantics.
+    # trace: optional trailing (omitted when None — the untraced wire is
+    # byte-identical to the pre-tracing schema): ``(trace id, entry
+    # index)`` when this window carries a traced entry to quorum, so the
+    # follower records its ingest+fsync span under the same causal
+    # timeline and marks the entry for event-push attribution
+    # (docs/OBSERVABILITY.md "Cluster-wide causal tracing").
     _fields = ("term", "leader", "prev_index", "prev_term", "entries", "commit_index",
-               "global_index", "fill_to", "group")
+               "global_index", "fill_to", "group", "trace")
+    _optional = 1
 
 
 @serialize_with(219)
@@ -299,14 +334,22 @@ class ProxyRequest(Message):
     the kind-specific ``result`` payload, plus the uniform
     error/leader-hint fields so the ingress can retry toward the
     group's current leader.
+
+    ``trace`` (optional trailing on both directions, omitted when
+    None): the originating trace id — the owning group's leader records
+    its append/quorum/apply spans under it, and the response echoes it
+    so the hop stays correlated even when responses are inspected off
+    the connection's multiplexing.
     """
 
-    _fields = ("group", "kind", "payload")
+    _fields = ("group", "kind", "payload", "trace")
+    _optional = 1
 
 
 @serialize_with(229)
 class ProxyResponse(Response):
-    _fields = ("error", "error_detail", "leader", "result")
+    _fields = ("error", "error_detail", "leader", "result", "trace")
+    _optional = 1
 
 
 @serialize_with(220)

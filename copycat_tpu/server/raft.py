@@ -43,6 +43,7 @@ from ..protocol.operations import QueryConsistency
 from ..utils import knobs
 from ..utils.managed import Managed
 from ..utils.metrics import MetricsRegistry
+from ..utils.tracing import TRACER
 from .log import ConfigurationEntry, Storage, StorageLevel
 from .raft_group import (  # noqa: F401 - re-exported compat surface
     CANDIDATE,
@@ -156,6 +157,14 @@ class RaftServer(Managed):
         machine_cls = type(self.groups[0].state_machine)
         self._route_group_fn = getattr(machine_cls, "route_group", None)
 
+        # Ingress-side phase histograms of the causal-tracing plane
+        # (docs/OBSERVABILITY.md): fed only by traced requests. On the
+        # single-group plane the registry is shared with group 0, so
+        # the family sits in one snapshot either way.
+        self._m_lat_ingress_queue = self._metrics.histogram(
+            "latency.ingress_queue_ms")
+        self._m_lat_proxy_hop = self._metrics.histogram(
+            "latency.proxy_hop_ms")
         if not self.single:
             m = self._metrics
             self._m_shard_local = m.counter("shard.commands_local")
@@ -473,12 +482,24 @@ class RaftServer(Managed):
                 del self._chains[key]
         return await task
 
-    async def _proxy(self, g: int, kind: str, payload: Any
-                     ) -> msg.ProxyResponse:
+    def _trace_span(self, trace: int, name: str, t0: float, t1: float,
+                    hist=None, **meta: Any) -> None:
+        """Ingress-side causal span (utils/tracing.py vocabulary),
+        tagged with this member so the cross-member assembly can place
+        the ingress phases, plus the matching ``latency.*`` histogram."""
+        TRACER.span(trace, name, t0, t1, member=str(self.address), **meta)
+        if hist is not None:
+            hist.record((t1 - t0) * 1e3)
+
+    async def _proxy(self, g: int, kind: str, payload: Any,
+                     trace: int | None = None) -> msg.ProxyResponse:
         """Dispatch one staged sub-request to group ``g``'s leader —
         locally when this member leads the group, else as a ProxyRequest
         over the peer connection, retrying toward the group's current
-        leader view (which updates via the group's own append stream)."""
+        leader view (which updates via the group's own append stream).
+        ``trace`` (the originating trace id) rides the ProxyRequest's
+        optional trailing field; each wire attempt records a
+        ``proxy.hop`` span (failed attempts tagged ``error=``)."""
         grp = self.groups[g]
         backoff = 0.01
         # the per-try budget must cover COMMIT latency, not just the
@@ -497,19 +518,37 @@ class RaftServer(Managed):
                 return msg.ProxyResponse(error=msg.NO_LEADER,
                                          error_detail="server closing")
             if grp.role == LEADER:
-                return await self._proxy_local(grp, kind, payload)
+                return await self._proxy_local(grp, kind, payload, trace)
             leader = grp.leader_address
             response = None
             if leader is not None and leader != self.address:
                 conn = await self._peer_connection(leader)
                 if conn is not None:
+                    t_hop = (time.perf_counter() if trace is not None
+                             else 0.0)
                     try:
                         response = await asyncio.wait_for(
                             conn.send(msg.ProxyRequest(
-                                group=g, kind=kind, payload=payload)),
+                                group=g, kind=kind, payload=payload,
+                                trace=trace)),
                             try_budget)
                     except (TransportError, OSError, asyncio.TimeoutError):
                         response = None
+                    if trace is not None:
+                        if response is not None:
+                            self._trace_span(trace, "proxy.hop", t_hop,
+                                             time.perf_counter(),
+                                             self._m_lat_proxy_hop,
+                                             group=g, to=str(leader))
+                        else:
+                            # the failed attempt stays on the timeline:
+                            # an assembly missing the group-side spans
+                            # shows WHERE the request died
+                            self._trace_span(trace, "proxy.hop", t_hop,
+                                             time.perf_counter(),
+                                             self._m_lat_proxy_hop,
+                                             group=g, to=str(leader),
+                                             error="unreachable")
             if response is not None and response.error not in (
                     msg.NOT_LEADER, msg.NO_LEADER):
                 return response
@@ -524,11 +563,16 @@ class RaftServer(Managed):
 
     async def _on_proxy(self, request: msg.ProxyRequest
                         ) -> msg.ProxyResponse:
-        return await self._proxy_local(self._group_of(request),
-                                       request.kind, request.payload)
+        trace = request.trace
+        response = await self._proxy_local(self._group_of(request),
+                                           request.kind, request.payload,
+                                           trace)
+        if trace is not None:
+            response.trace = trace  # echo: the hop stays correlated
+        return response
 
-    async def _proxy_local(self, grp: RaftGroup, kind: str, payload: Any
-                           ) -> msg.ProxyResponse:
+    async def _proxy_local(self, grp: RaftGroup, kind: str, payload: Any,
+                           trace: int | None = None) -> msg.ProxyResponse:
         """Serve one staged sub-request on a group this member leads
         (the proxy handler on the receiving leader, and the local
         shortcut at the ingress)."""
@@ -537,7 +581,8 @@ class RaftServer(Managed):
                 session_id, entries = payload
                 out, err = await grp.command_block(session_id,
                                                    [tuple(e)
-                                                    for e in entries])
+                                                    for e in entries],
+                                                   trace)
                 if err is not None:
                     code, detail, leader = err
                     return msg.ProxyResponse(error=code, error_detail=detail,
@@ -656,20 +701,31 @@ class RaftServer(Managed):
                                           leader=first.leader)
         return msg.UnregisterResponse()
 
-    async def _dispatch_commands(self, g: int, session_id: int,
-                                 sub: list) -> Any:
+    async def _dispatch_commands(self, g: int, session_id: int, sub: list,
+                                 trace: int | None = None,
+                                 t0: float = 0.0) -> Any:
         """One group's command sub-block, in per-(session, group) order;
         returns the tagged per-entry outcomes, or ``(code, detail,
-        leader)`` for a response-level failure."""
+        leader)`` for a response-level failure. When traced, the wait
+        from ingress receipt (``t0``) until the dispatch chain released
+        this sub-block records as ``ingress.queue``."""
         grp = self.groups[g]
         if grp.role == LEADER:
             self._m_shard_local.inc(len(sub))
         else:
             self._m_shard_proxied.inc(len(sub))
         self._m_routed[g].inc(len(sub))
-        response = await self._chained(
-            (session_id, g),
-            lambda: self._proxy(g, "commands", (session_id, sub)))
+
+        async def dispatch() -> msg.ProxyResponse:
+            if trace is not None:
+                self._trace_span(trace, "ingress.queue", t0,
+                                 time.perf_counter(),
+                                 self._m_lat_ingress_queue, group=g,
+                                 n=len(sub))
+            return await self._proxy(g, "commands", (session_id, sub),
+                                     trace)
+
+        response = await self._chained((session_id, g), dispatch)
         if response.error:
             return (response.error, response.error_detail or "",
                     response.leader)
@@ -687,11 +743,13 @@ class RaftServer(Managed):
         rep0 = self.groups[0].sessions.get(sid)
         self._touch_session(sid, connection, time.monotonic())
         entries = request.entries or []
+        trace = request.trace
+        t0 = time.perf_counter() if trace is not None else 0.0
         buckets: dict[int, list] = {}
         for seq, op in entries:
             buckets.setdefault(self._route(op), []).append((seq, op))
         results = await asyncio.gather(*(
-            self._dispatch_commands(g, sid, sub)
+            self._dispatch_commands(g, sid, sub, trace, t0)
             for g, sub in buckets.items()))
         merged: dict[int, tuple] = {}
         for res in results:
@@ -715,8 +773,10 @@ class RaftServer(Managed):
         rep0 = self.groups[0].sessions.get(sid)
         self._touch_session(sid, connection, time.monotonic())
         g = self._route(request.operation)
+        trace = request.trace
         res = await self._dispatch_commands(
-            g, sid, [(request.seq, request.operation)])
+            g, sid, [(request.seq, request.operation)], trace,
+            time.perf_counter() if trace is not None else 0.0)
         if isinstance(res, tuple):
             code, detail, leader = res
             return msg.CommandResponse(error=code, error_detail=detail,

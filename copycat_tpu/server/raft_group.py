@@ -48,6 +48,7 @@ from ..io.serializer import Serializer
 from ..io.transport import Address, Connection, TransportError
 from ..protocol import messages as msg
 from ..protocol.operations import Command, CommandConsistency, QueryConsistency
+from ..utils import knobs
 from ..utils.scheduled import Scheduled
 from ..utils.tasks import spawn
 from ..utils.tracing import TRACER
@@ -80,17 +81,21 @@ class _EntryCtx:
     between replicas with different commit-batch boundaries.
     """
 
-    __slots__ = ("raft", "index", "clock", "touched", "buffer",
+    __slots__ = ("raft", "index", "clock", "touched", "buffer", "trace",
                  "_prev_touched", "_prev_buffer", "_prev_index",
                  "_prev_clock")
 
-    def __init__(self, raft: "RaftGroup", entry: Entry) -> None:
+    def __init__(self, raft: "RaftGroup", entry: Entry,
+                 trace: int | None = None) -> None:
         self.raft = raft
         self.index = entry.index
         # _apply_entry already advanced context.clock to this entry
         self.clock = raft.context.clock
         self.touched: set = set()
         self.buffer: list = []
+        # originating trace id for event-push attribution at
+        # finalization (the causal-tracing plane; None when untraced)
+        self.trace = trace
 
     def __enter__(self) -> "_EntryCtx":
         r = self.raft
@@ -278,6 +283,38 @@ class RaftGroup:
         self._m_snap_restores = m.counter("snap.restores")
         self._m_snap_restore_ms = m.histogram("snap.restore_ms")
         self._m_snap_meta_fallback = m.counter("snap.meta_fallbacks")
+        # Per-phase commit-latency attribution (docs/OBSERVABILITY.md
+        # "Cluster-wide causal tracing"): fed ONLY by traced requests —
+        # the client's trace flag is the sampling switch, so the
+        # untraced hot path never touches these. Pre-created so the
+        # family is present (count 0) in every snapshot the CI asserts.
+        self._m_lat_append = m.histogram("latency.append_ms")
+        self._m_lat_quorum = m.histogram("latency.quorum_ms")
+        self._m_lat_fsync = m.histogram("latency.fsync_ms")
+        self._m_lat_apply = m.histogram("latency.apply_ms")
+        self._m_lat_respond = m.histogram("latency.respond_ms")
+        self._m_lat_commit = m.histogram("latency.commit_ms")
+        self._m_lat_event_push = m.histogram("latency.event_push_ms")
+        self._m_lat_follower = m.histogram("latency.follower_append_ms")
+
+        # causal-tracing bookkeeping (all empty unless requests carry a
+        # trace id — the disabled hot path pays empty-dict truthiness
+        # checks only): watch = appended-index -> (trace, t_append) for
+        # the quorum.wait split (popped the instant commit covers it);
+        # window marks = appended-index -> trace for stamping
+        # replication windows, retained until EVERY member has the
+        # entry (pruned at global_index — a commit-time pop would stop
+        # stamping windows to stragglers, losing exactly the laggy
+        # members' spans); commit_t = trace -> instant the commit
+        # boundary (incl. fsync) covered it, read by the awaiting
+        # coroutine for the apply span; entry marks = log index ->
+        # trace, consumed by the apply loop to stamp event pushes.
+        self._trace_watch: dict[int, tuple[int, float]] = {}
+        self._trace_window_marks: dict[int, int] = {}
+        self._trace_commit_t: dict[int, float] = {}
+        self._trace_entry_marks: dict[int, int] = {}
+        self._member = str(self.address)
+        self._trace_slow_ms = knobs.get_float("COPYCAT_TRACE_SLOW_MS")
 
         # crash-recovery plane (per group: own snapshot store + meta file)
         self._snapshots: SnapshotStore | None = None
@@ -389,6 +426,7 @@ class RaftGroup:
         half of the server's ``_do_close``); the log closes here too."""
         self._cancel_timers()
         self._stop_replication()
+        self._trace_clear()
         for fut in self._commit_futures.values():
             if not fut.done():
                 fut.set_exception(
@@ -456,6 +494,34 @@ class RaftGroup:
             self._flight_note("meta_corrupt", path=path, error=str(e))
             self.term = 0
             self.voted_for = None
+
+    def _trace_span(self, trace: int, name: str, t0: float, t1: float,
+                    hist=None, **meta: Any) -> None:
+        """Record one server-side span under ``trace`` — tagged with
+        this member + group so the cross-member assembly can attribute
+        it — and feed the matching ``latency.*`` phase histogram."""
+        TRACER.span(trace, name, t0, t1, member=self._member,
+                    group=self.group_id, **meta)
+        if hist is not None:
+            hist.record((t1 - t0) * 1e3)
+
+    def _trace_note_slow(self, trace: int, t0: float, t1: float) -> None:
+        """Slow-trace exemplar: a traced request whose server residency
+        exceeded ``COPYCAT_TRACE_SLOW_MS`` lands in the device-plane
+        flight recorder, next to whatever fault caused it."""
+        ms = (t1 - t0) * 1e3
+        if ms >= self._trace_slow_ms:
+            self._flight_note("slow_trace", trace=trace,
+                              ms=round(ms, 3))
+
+    def _trace_clear(self) -> None:
+        """Drop causal-tracing bookkeeping (leadership loss/shutdown:
+        the awaiting coroutines are failing with NOT_LEADER and nothing
+        will consume the watches)."""
+        self._trace_watch.clear()
+        self._trace_window_marks.clear()
+        self._trace_commit_t.clear()
+        self._trace_entry_marks.clear()
 
     def _flight_note(self, kind: str, **fields) -> None:
         """Best-effort note in the device-plane flight recorder (the ring
@@ -789,6 +855,7 @@ class RaftGroup:
             self._leader_timer = None
 
     def _fail_pending(self, code: str) -> None:
+        self._trace_clear()
         for fut in self._commit_futures.values():
             if not fut.done():
                 fut.set_exception(
@@ -881,13 +948,31 @@ class RaftGroup:
         prev_index = next_index - 1
         entries = self.log.entries_from(next_index, limit=limit)
         covered_end = min(next_index + limit - 1, self.log.last_index)
+        trace = None
+        if self._trace_window_marks and covered_end >= next_index:
+            # this window carries a traced entry toward quorum: stamp
+            # ``(trace id, entry index)`` (an OPTIONAL trailing wire
+            # field — untraced windows stay byte-identical) so the
+            # follower records its ingest under the same causal
+            # timeline AND marks the entry for event-push attribution
+            # (the connection-holding member pushes from its own apply).
+            # Window marks outlive the quorum watch: a straggler whose
+            # window is staged after commit still gets the stamp. The
+            # field carries ONE (trace, index) pair — when entries of
+            # several concurrent traces coalesce into one window, only
+            # the first gets follower-side spans (a documented sampling
+            # limitation, not a correctness hazard: leader-side phases
+            # and the client span always land for every trace).
+            trace = next(((t, i) for i, t
+                          in self._trace_window_marks.items()
+                          if next_index <= i <= covered_end), None)
         request = msg.AppendRequest(
             term=self.term, leader=self.address,
             prev_index=prev_index, prev_term=self.log.term_at(prev_index),
             entries=entries, commit_index=self.commit_index,
             global_index=self.global_index,
             fill_to=covered_end if covered_end >= next_index else None,
-            group=self.wire_group)
+            group=self.wire_group, trace=trace)
         if covered_end >= next_index:
             self._m_repl_windows.inc()
             self._m_repl_entries.inc(len(entries))
@@ -1267,8 +1352,31 @@ class RaftGroup:
                         f"supported by {support}/{len(self.members)} "
                         f"(quorum {self.quorum}, last {self.log.last_index})")
             self.commit_index = candidate
+            hit: list[int] = []
+            if self._trace_watch:
+                # traced entries the quorum just covered: close their
+                # quorum.wait span here — the instant commit advanced —
+                # and remember the commit instant so the awaiting
+                # coroutine can attribute the apply phase separately
+                now = time.perf_counter()
+                for index in [i for i in self._trace_watch
+                              if i <= candidate]:
+                    trace, t_append = self._trace_watch.pop(index)
+                    self._trace_span(trace, "quorum.wait", t_append, now,
+                                     self._m_lat_quorum, index=index)
+                    self._trace_commit_t[trace] = now
+                    hit.append(trace)
             if self._fsync_on_commit:
-                self.log.sync()  # commit boundary: acknowledged = durable
+                if hit:
+                    t_s = time.perf_counter()
+                    self.log.sync()
+                    t_e = time.perf_counter()
+                    for trace in hit:
+                        self._trace_span(trace, "group.fsync", t_s, t_e,
+                                         self._m_lat_fsync)
+                        self._trace_commit_t[trace] = t_e
+                else:
+                    self.log.sync()  # commit boundary: ack = durable
             self._apply_up_to(self.commit_index)
         # global index: minimum replicated position across all members
         if self.peers:
@@ -1277,6 +1385,12 @@ class RaftGroup:
                 + [self.match_index.get(p, 0) for p in self.peers])
         else:
             self.global_index = self.last_applied
+        if self._trace_window_marks:
+            # every member holds entries <= global_index: no future
+            # window will carry them, the stamps can go
+            for i in [i for i in self._trace_window_marks
+                      if i <= self.global_index]:
+                del self._trace_window_marks[i]
         if self.log.cleaned_count > 0:
             self.log.compact(min(self.global_index, self.last_applied))
 
@@ -1387,6 +1501,11 @@ class RaftGroup:
             # not pollute the append-size histogram / heartbeat counter
             return msg.AppendResponse(term=self.term, success=False,
                                       last_index=self.log.last_index)
+        trace_mark = request.trace  # (trace id, traced entry index)
+        if type(trace_mark) is not tuple or len(trace_mark) != 2:
+            trace_mark = None  # malformed peer payload: ignore, don't die
+        trace = trace_mark[0] if trace_mark is not None else None
+        t_trace = time.perf_counter() if trace is not None else 0.0
         if request.entries:
             self._m_append_entries.record(len(request.entries))
         else:
@@ -1450,6 +1569,14 @@ class RaftGroup:
         if fill_to > self.log.last_index:
             self.log.fill_gap(fill_to)
 
+        if trace is not None and trace_mark[1] > self.last_applied:
+            # the window was ACCEPTED (every reject path returned above):
+            # mark the traced entry so that, if this member holds the
+            # client's connection, its apply attributes the event push —
+            # marking before acceptance would let a rejected window's
+            # stale mark mis-attribute a different entry later
+            self._trace_entry_marks[trace_mark[1]] = trace
+
         commit = min(request.commit_index or 0, self.log.last_index)
         if commit > self.commit_index:
             self.commit_index = commit
@@ -1459,6 +1586,13 @@ class RaftGroup:
         global_index = getattr(request, "global_index", None)
         if global_index:
             self.log.compact(min(global_index, self.last_applied))
+        if trace is not None:
+            # the window carried a traced entry: this member's ingest
+            # (conflict scan + block append + fsync + commit advance) on
+            # the originating causal timeline
+            self._trace_span(trace, "follower.append", t_trace,
+                             time.perf_counter(), self._m_lat_follower,
+                             n=len(entries))
         return msg.AppendResponse(term=self.term, success=True,
                                   last_index=self.log.last_index)
 
@@ -1624,8 +1758,8 @@ class RaftGroup:
         if staged == "done":
             index, result, error = payload
             if trace is not None:
-                TRACER.span(trace, "server.cached", t0, time.perf_counter(),
-                            seq=seq)
+                self._trace_span(trace, "group.cached", t0,
+                                 time.perf_counter(), seq=seq)
             return self._command_response(session, index, result, error)
         if staged == "err":
             code, detail = payload
@@ -1633,7 +1767,8 @@ class RaftGroup:
         fut = payload
         if trace is not None:
             t1 = time.perf_counter()
-            TRACER.span(trace, "server.append", t0, t1, seq=seq)
+            self._trace_span(trace, "group.append", t0, t1,
+                             self._m_lat_append, seq=seq)
         try:
             index, result, error = await fut
         except msg.ProtocolError as e:
@@ -1642,8 +1777,12 @@ class RaftGroup:
             if session.command_futures.get(seq) is fut:
                 del session.command_futures[seq]
         if trace is not None:
-            TRACER.span(trace, "server.commit", t1, time.perf_counter(),
-                        index=index)
+            # coarse commit span (append -> commit+apply): the per-seq
+            # lane stages through futures whose log index is unknown
+            # here, so the quorum/apply split rides the block lanes
+            self._trace_span(trace, "group.commit", t1,
+                             time.perf_counter(), self._m_lat_commit,
+                             index=index)
         return self._command_response(session, index, result, error)
 
     def _stage_command(self, session: ServerSession, seq: int,
@@ -1725,7 +1864,8 @@ class RaftGroup:
                   for seq, op in entries]
         if trace is not None:
             t1 = time.perf_counter()
-            TRACER.span(trace, "server.append", t0, t1, n=n)
+            self._trace_span(trace, "group.append", t0, t1,
+                             self._m_lat_append, n=n)
         entries = []
         for seq, kind, payload in staged:
             if kind == "done":
@@ -1757,7 +1897,8 @@ class RaftGroup:
                     if session.command_futures.get(seq) is fut:
                         del session.command_futures[seq]
         if trace is not None:
-            TRACER.span(trace, "server.commit", t1, time.perf_counter(), n=n)
+            self._trace_span(trace, "group.commit", t1,
+                             time.perf_counter(), self._m_lat_commit, n=n)
         return msg.CommandBatchResponse(event_index=session.event_index,
                                         entries=entries)
 
@@ -1788,11 +1929,24 @@ class RaftGroup:
             asyncio.get_running_loop().call_soon(self._advance_deferred)
         if trace is not None:
             t1 = time.perf_counter()
-            TRACER.span(trace, "server.append", t0, t1, index=index,
-                        n=len(entries))
+            self._trace_span(trace, "group.append", t0, t1,
+                             self._m_lat_append, index=index,
+                             n=len(entries))
+            # quorum.wait / group.fsync close in _advance_commit the
+            # instant the commit boundary covers this block; the apply
+            # loop stamps event pushes via the per-index marks
+            self._trace_watch[index] = (trace, t1)
+            self._trace_window_marks[index] = trace
+            for i in range(index - len(entries) + 1, index + 1):
+                self._trace_entry_marks[i] = trace
         try:
             await fut
         except msg.ProtocolError as e:
+            if trace is not None:
+                self._trace_watch.pop(index, None)
+                self._trace_commit_t.pop(trace, None)
+                for i in range(index - len(entries) + 1, index + 1):
+                    self._trace_entry_marks.pop(i, None)
             if e.code in (msg.NOT_LEADER, msg.NO_LEADER):
                 # same promotion as the general path: the client's
                 # _request loop re-routes and resends the whole batch
@@ -1805,7 +1959,9 @@ class RaftGroup:
                          for seq, _ in entries])
         if trace is not None:
             t2 = time.perf_counter()
-            TRACER.span(trace, "server.commit", t1, t2, index=index)
+            t_commit = self._trace_commit_t.pop(trace, t1)
+            self._trace_span(trace, "apply", t_commit, t2,
+                             self._m_lat_apply, index=index)
         if self._event_pushes:
             # Events-before-response (reference Consistency.java:157-176):
             # the general path gates each LINEARIZABLE response on its
@@ -1815,12 +1971,17 @@ class RaftGroup:
             # block's applies spawned — under the same 1 s cap. Empty in
             # the listener-free steady state, so the fast path pays one
             # set check.
+            t_push = time.perf_counter() if trace is not None else 0.0
             try:
                 await asyncio.wait_for(
                     asyncio.gather(*list(self._event_pushes),
                                    return_exceptions=True), 1.0)
             except asyncio.TimeoutError:
                 pass
+            if trace is not None:
+                self._trace_span(trace, "event.push", t_push,
+                                 time.perf_counter(),
+                                 self._m_lat_event_push)
         responses = session.responses
         out = []
         for seq, _ in entries:
@@ -1834,7 +1995,13 @@ class RaftGroup:
                 out.append((seq, idx, result,
                             msg.APPLICATION if error else None, error))
         if trace is not None:
-            TRACER.span(trace, "server.respond", t2, time.perf_counter())
+            t3 = time.perf_counter()
+            self._trace_span(trace, "respond", t2, t3, self._m_lat_respond)
+            # stale per-entry marks (entries the vector lane applied or
+            # a session death skipped) must not leak
+            for i in range(index - len(entries) + 1, index + 1):
+                self._trace_entry_marks.pop(i, None)
+            self._trace_note_slow(trace, t0, t3)
         return msg.CommandBatchResponse(event_index=session.event_index,
                                         entries=out)
 
@@ -1881,11 +2048,16 @@ class RaftGroup:
         return self._append_and_wait(
             UnregisterEntry(session_id=session_id, expired=False))
 
-    async def command_block(self, session_id: int, entries: list
+    async def command_block(self, session_id: int, entries: list,
+                            trace: int | None = None
                             ) -> tuple[list | None, tuple | None]:
         """Stage one routed (possibly gapped) command sub-block on this
         group's leader; returns ``(per_entry_outcomes, None)`` or
         ``(None, (code, detail, leader))`` for a response-level failure.
+        ``trace`` is the originating trace id from the ingress (carried
+        by ProxyRequest when proxied): the full per-phase decomposition
+        — group.append / quorum.wait / group.fsync / apply / respond —
+        records under it on THIS member.
 
         The dedup walk mirrors ``_stage_command`` minus the dense-seq
         parking: seqs the routing assigned to OTHER groups never arrive
@@ -1893,6 +2065,7 @@ class RaftGroup:
         delivery per (session, group) is the ingress's proxy-chain
         contract, and anything below the appended high-water that is not
         cached or in flight is a duplicate."""
+        t0 = time.perf_counter() if trace is not None else 0.0
         if self.role != LEADER:
             return None, (msg.NOT_LEADER if self.leader_address
                           else msg.NO_LEADER, "", self.leader_address)
@@ -1925,6 +2098,8 @@ class RaftGroup:
                              f"response for seq {seq} already pruned")
         self._m_fast_lane.inc(len(fresh))
         block_fut: asyncio.Future | None = None
+        index = 0
+        t1 = t0
         if fresh:
             term = self.term
             now = time.time()
@@ -1940,6 +2115,15 @@ class RaftGroup:
             if len(self.members) == 1 and not self._advance_scheduled:
                 self._advance_scheduled = True
                 asyncio.get_running_loop().call_soon(self._advance_deferred)
+            if trace is not None:
+                t1 = time.perf_counter()
+                self._trace_span(trace, "group.append", t0, t1,
+                                 self._m_lat_append, index=index,
+                                 n=len(fresh))
+                self._trace_watch[index] = (trace, t1)
+                self._trace_window_marks[index] = trace
+                for i in range(index - len(fresh) + 1, index + 1):
+                    self._trace_entry_marks[i] = trace
         pending = session.last_block_future
         try:
             if block_fut is not None:
@@ -1950,7 +2134,24 @@ class RaftGroup:
                 if fut is not None:
                     await fut
         except msg.ProtocolError as e:
+            if trace is not None and fresh:
+                self._trace_watch.pop(index, None)
+                self._trace_commit_t.pop(trace, None)
+                for i in range(index - len(fresh) + 1, index + 1):
+                    self._trace_entry_marks.pop(i, None)
             return None, (e.code, e.detail, e.leader)
+        t2 = 0.0
+        if trace is not None:
+            t2 = time.perf_counter()
+            if fresh:
+                t_commit = self._trace_commit_t.pop(trace, t1)
+                self._trace_span(trace, "apply", t_commit, t2,
+                                 self._m_lat_apply, index=index)
+            else:
+                # nothing appended (pure dedup/in-flight waits): the
+                # coarse commit span is all there is to attribute
+                self._trace_span(trace, "group.commit", t0, t2,
+                                 self._m_lat_commit)
         responses = session.responses
         out = []
         for seq, _ in entries:
@@ -1966,6 +2167,13 @@ class RaftGroup:
                 idx, result, error = cached
                 out.append((seq, idx, result,
                             msg.APPLICATION if error else None, error))
+        if trace is not None:
+            t3 = time.perf_counter()
+            self._trace_span(trace, "respond", t2, t3, self._m_lat_respond)
+            if fresh:
+                for i in range(index - len(fresh) + 1, index + 1):
+                    self._trace_entry_marks.pop(i, None)
+            self._trace_note_slow(trace, t0, t3)
         return out, None
 
     async def serve_query(self, session_id: int, client_index: int,
@@ -2464,8 +2672,13 @@ class RaftGroup:
         clock = self.context.clock
         log = self.log
         futures = self._commit_futures
+        marks = self._trace_entry_marks
         for k, (entry, session, machine, instance, inner, spec) in \
                 enumerate(run):
+            if marks:
+                # vector-lane entries never publish session events, so
+                # the mark is only consumed for leak hygiene here
+                marks.pop(entry.index, None)
             if entry.timestamp > clock:
                 clock = entry.timestamp
             if pump_error is None and raws[k] == self._DEVICE_FAIL:
@@ -2511,8 +2724,15 @@ class RaftGroup:
             window.barrier()
         self.context.index = entry.index
         self.context.clock = max(self.context.clock, entry.timestamp)
+        # originating trace for this entry, when its staging marked one
+        # (empty-dict truthiness is the whole untraced cost): events the
+        # apply publishes ride PublishRequest under the same id — popped
+        # BEFORE the windowed-lane branch so device-backed applies
+        # neither leak marks nor lose event attribution
+        marks = self._trace_entry_marks
+        trace = marks.pop(entry.index, None) if marks else None
         if window is not None and isinstance(entry, CommandEntry):
-            self._apply_command_windowed(entry, window)
+            self._apply_command_windowed(entry, window, trace)
             return
         # Reset BEFORE ticking: timer callbacks publish session events too,
         # and those must be sealed/pushed with this entry.
@@ -2535,7 +2755,7 @@ class RaftGroup:
             self.log.clean(entry.index)
 
         # Seal + push session events produced by this entry.
-        pushes = self._seal_and_push(self._touched_sessions)
+        pushes = self._seal_and_push(self._touched_sessions, trace)
 
         fut = self._commit_futures.pop(entry.index, None)
         if fut is not None and not fut.done():
@@ -2543,7 +2763,8 @@ class RaftGroup:
         if isinstance(entry, CommandEntry):
             self._complete_command(entry, result, error, pushes)
 
-    def _seal_and_push(self, touched) -> list[asyncio.Task]:
+    def _seal_and_push(self, touched,
+                       trace: int | None = None) -> list[asyncio.Task]:
         pushes: list[asyncio.Task] = []
         for session in touched:
             batch = session.commit_events()
@@ -2557,7 +2778,7 @@ class RaftGroup:
             # "event channels").
             if (self.role == LEADER if self.server.single
                     else session.connection is not None):
-                task = self._push_events(session)
+                task = self._push_events(session, trace)
                 if task is not None:
                     pushes.append(task)
                     self._event_pushes.add(task)
@@ -2566,13 +2787,13 @@ class RaftGroup:
 
     # -- windowed apply (device executor) ------------------------------
 
-    def _apply_command_windowed(self, entry: CommandEntry,
-                                window: Any) -> None:
+    def _apply_command_windowed(self, entry: CommandEntry, window: Any,
+                                trace: int | None = None) -> None:
         """Apply one command entry under the device window: the handler may
         return a suspended device-op chain (DeviceJob) that is deferred
         into the shared round pump; its finalization (response cache,
         event seal/push, futures) runs at the entry's log-ordered slot."""
-        ctx = _EntryCtx(self, entry)
+        ctx = _EntryCtx(self, entry, trace)
         window.job_ctx = ctx  # timer chains spawned by tick inherit it
         try:
             with ctx:
@@ -2604,7 +2825,7 @@ class RaftGroup:
     def _finalize_entry(self, entry: CommandEntry, result: Any,
                         error: str | None, ctx: "_EntryCtx") -> None:
         ctx.replay()  # buffered publishes land in log order
-        pushes = self._seal_and_push(ctx.touched)
+        pushes = self._seal_and_push(ctx.touched, ctx.trace)
         fut = self._commit_futures.pop(entry.index, None)
         if fut is not None and not fut.done():
             fut.set_result((entry.index, result, error))
@@ -2792,37 +3013,52 @@ class RaftGroup:
     # event push (connection-holder only; leader == holder when single)
     # ------------------------------------------------------------------
 
-    def _push_events(self, session: ServerSession) -> asyncio.Task | None:
+    def _push_events(self, session: ServerSession,
+                     trace: int | None = None) -> asyncio.Task | None:
         if session.connection is None or session.connection.closed:
             return None
-        return spawn(self._flush_events_async(session), name="event-push")
+        return spawn(self._flush_events_async(session, trace),
+                     name="event-push")
 
     def _flush_events(self, session: ServerSession) -> None:
         self._push_events(session)
 
-    async def _flush_events_async(self, session: ServerSession) -> None:
+    async def _flush_events_async(self, session: ServerSession,
+                                  trace: int | None = None) -> None:
         conn = session.connection
         if conn is None or conn.closed:
             return
-        for batch in list(session.event_queue):
-            if batch.event_index <= session.event_ack_index:
-                continue
-            try:
-                response = await asyncio.wait_for(
-                    conn.send(msg.PublishRequest(
-                        session_id=session.id,
-                        event_index=batch.event_index,
-                        prev_event_index=batch.prev_event_index,
-                        events=batch.events,
-                        group=self.wire_group)),
-                    1.0)
-            except (TransportError, OSError, asyncio.TimeoutError):
-                return
-            if response.event_index is not None:
-                session.ack_events(response.event_index)
-                if response.event_index < batch.event_index:
-                    # client is behind; it will be caught up on next pass
+        t0 = time.perf_counter() if trace is not None else 0.0
+        pushed = False
+        try:
+            for batch in list(session.event_queue):
+                if batch.event_index <= session.event_ack_index:
+                    continue
+                try:
+                    response = await asyncio.wait_for(
+                        conn.send(msg.PublishRequest(
+                            session_id=session.id,
+                            event_index=batch.event_index,
+                            prev_event_index=batch.prev_event_index,
+                            events=batch.events,
+                            group=self.wire_group, trace=trace)),
+                        1.0)
+                except (TransportError, OSError, asyncio.TimeoutError):
                     return
+                pushed = True
+                if response.event_index is not None:
+                    session.ack_events(response.event_index)
+                    if response.event_index < batch.event_index:
+                        # client is behind; caught up on the next pass
+                        return
+        finally:
+            # any completed push (including one before a catching-up
+            # early return) is timeline-worthy — an asymmetric trace
+            # with a client.event but no event.push reads as a hole
+            if trace is not None and pushed:
+                self._trace_span(trace, "event.push", t0,
+                                 time.perf_counter(),
+                                 self._m_lat_event_push)
 
     # ------------------------------------------------------------------
     # observability

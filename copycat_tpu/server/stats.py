@@ -16,6 +16,9 @@ Routes:
   resource manager's under ``copycat_manager_*``.
 - ``/traces`` — JSON dump of the slowest traced requests
   (``utils/tracing.py``); ``/traces.txt`` for the human rendering.
+- ``/traces/<id>`` — THIS member's spans for one trace id: the
+  collection route ``copycat-tpu trace`` fans out across members to
+  assemble the cross-member causal waterfall.
 - ``/flight`` — the device-plane flight recorder (telemetry spikes,
   injected faults, invariant violations in one fault-correlated ring —
   ``models/telemetry.py``); ``/flight.txt`` for the human rendering.
@@ -122,6 +125,23 @@ class StatsListener:
                 "application/json"
         if path == "/traces.txt":
             return TRACER.dump_slowest(20).encode(), "text/plain"
+        if path.startswith("/traces/"):
+            # the cross-member collection route: THIS member's spans for
+            # one trace id (`copycat-tpu trace` fans this out to every
+            # member and assembles the causal waterfall — utils/tracing
+            # `assemble_trace`); unknown/evicted ids serve an empty span
+            # list, which the assembler marks incomplete, never drops
+            try:
+                trace_id = int(path.rsplit("/", 1)[1])
+            except ValueError:
+                return (json.dumps({"error": "trace id must be an int"})
+                        .encode(), "application/json")
+            spans = [s.as_dict() for s in TRACER.spans_for(trace_id)]
+            return (json.dumps({
+                "trace": trace_id,
+                "member": str(self._raft.address),
+                "spans": spans,
+            }).encode(), "application/json")
         if path == "/flight":
             hub = self._device_hub()
             body = (hub.flight.render_json() if hub is not None
@@ -140,8 +160,8 @@ class StatsListener:
                 "application/json"
         return (json.dumps({"error": f"unknown path {path}",
                             "routes": ["/stats", "/metrics", "/traces",
-                                       "/traces.txt", "/flight",
-                                       "/flight.txt"]}).encode(),
+                                       "/traces.txt", "/traces/<id>",
+                                       "/flight", "/flight.txt"]}).encode(),
                 "application/json")
 
     def _device_hub(self):

@@ -127,6 +127,13 @@ _knob("COPYCAT_SNAP_CHUNK", "int", 262144,
 _knob("COPYCAT_TRACE", "bool", False,
       "per-request tracing (`utils/tracing.py`); zero-cost when off",
       section="observability")
+_knob("COPYCAT_TRACE_CAPACITY", "int", 512,
+      "traces held in the per-process ring before oldest-first eviction "
+      "(evicted ids are tombstoned, never resurrected)",
+      section="observability")
+_knob("COPYCAT_TRACE_SLOW_MS", "float", 100.0,
+      "traced requests slower than this land a `slow_trace` exemplar in "
+      "the device-plane flight recorder", section="observability")
 _knob("COPYCAT_TELEMETRY", "bool", False,
       "compile the device telemetry block into engines whose `Config` "
       "left it off", section="observability")
@@ -280,6 +287,10 @@ _knob("COPYCAT_BENCH_SHARDED_KEYS", "int", 1024,
 _knob("COPYCAT_BENCH_SHARDED_ZIPF", "float", 0.9,
       "zipf skew exponent for the sharded scenario's key draw",
       section="bench")
+_knob("COPYCAT_BENCH_SHARDED_TRACE", "bool", False,
+      "`1` drives one traced client wave after the timed bursts and "
+      "embeds the assembled cross-member waterfall + `latency.*` phase "
+      "histograms in the `--metrics-json` artifact", section="bench")
 _knob("COPYCAT_BENCH_SHARDED_DELAY_MS", "float", 100.0,
       "nemesis wire latency per leg, ms (cross-region shape: the "
       "bounded replication window caps a single ordered log at "

@@ -38,6 +38,7 @@
 static PyObject *g_id_by_type;   /* dict: type -> int */
 static PyObject *g_type_by_id;   /* dict: int -> type */
 static PyObject *g_fields_by_id; /* dict: int -> tuple[str] | None */
+static PyObject *g_optional_by_id; /* dict: int -> int (trailing optional) */
 static PyObject *g_encode_body;  /* callable(obj) -> bytes (custom types) */
 static PyObject *g_decode_body;  /* callable(cls, bytes, pos) -> (obj, pos) */
 static PyObject *g_fallback;     /* exception type */
@@ -197,6 +198,28 @@ static int enc_registered(PyObject *obj, Writer *w, int depth) {
         return rc;
     }
     Py_ssize_t nf = PyTuple_GET_SIZE(fields);
+    /* wire-optional trailing fields (Message._optional): a trailing
+     * None run is omitted entirely, matching the Python reference walk
+     * — the untraced RPC frame stays byte-identical to the schema
+     * before the field existed. */
+    PyObject *optobj = g_optional_by_id
+        ? PyDict_GetItemWithError(g_optional_by_id, idobj) : NULL;
+    if (!optobj && PyErr_Occurred()) return -1;
+    long long nopt = 0;
+    if (optobj) {
+        nopt = PyLong_AsLongLong(optobj);
+        if (nopt < 0 && PyErr_Occurred()) return -1;
+    }
+    while (nopt > 0 && nf > 0) {
+        PyObject *tail = PyObject_GetAttr(obj, PyTuple_GET_ITEM(fields,
+                                                                nf - 1));
+        if (!tail) return -1;
+        int is_none = (tail == Py_None);
+        Py_DECREF(tail);
+        if (!is_none) break;
+        nf--;
+        nopt--;
+    }
     for (Py_ssize_t i = 0; i < nf; i++) {
         PyObject *val = PyObject_GetAttr(obj, PyTuple_GET_ITEM(fields, i));
         if (!val) return -1;
@@ -341,10 +364,13 @@ static PyObject *dec_registered(Reader *r, long long tid, int depth) {
         return NULL;
     }
     PyObject *fields = PyDict_GetItemWithError(g_fields_by_id, idobj);
+    if (!fields && PyErr_Occurred()) { Py_DECREF(idobj); return NULL; }
+    PyObject *optobj = (fields && g_optional_by_id)
+        ? PyDict_GetItemWithError(g_optional_by_id, idobj) : NULL;
     Py_DECREF(idobj);
+    if (!optobj && PyErr_Occurred()) return NULL;
     if (!fields) {
-        if (!PyErr_Occurred())
-            PyErr_Format(g_fallback, "no codec meta for id %lld", tid);
+        PyErr_Format(g_fallback, "no codec meta for id %lld", tid);
         return NULL;
     }
     if (fields == Py_None) { /* custom read_object via Python */
@@ -379,9 +405,24 @@ static PyObject *dec_registered(Reader *r, long long tid, int depth) {
     }
     if (!obj) return NULL;
     Py_ssize_t nf = PyTuple_GET_SIZE(fields);
+    long long nopt = 0;
+    if (optobj) {
+        nopt = PyLong_AsLongLong(optobj);
+        if (nopt < 0 && PyErr_Occurred()) { Py_DECREF(obj); return NULL; }
+    }
+    Py_ssize_t required = nf - (Py_ssize_t)nopt;
     for (Py_ssize_t i = 0; i < nf; i++) {
-        PyObject *val = dec(r, depth);
-        if (!val) { Py_DECREF(obj); return NULL; }
+        PyObject *val;
+        if (i >= required && r->pos >= r->len) {
+            /* omitted wire-optional tail: the message ends its buffer
+             * (frames carry exactly one message), fill with None —
+             * mirrors Message.read_object in the Python reference */
+            val = Py_None;
+            Py_INCREF(val);
+        } else {
+            val = dec(r, depth);
+            if (!val) { Py_DECREF(obj); return NULL; }
+        }
         int rc = PyObject_SetAttr(obj, PyTuple_GET_ITEM(fields, i), val);
         Py_DECREF(val);
         if (rc < 0) { Py_DECREF(obj); return NULL; }
@@ -642,21 +683,24 @@ static PyObject *codec_encode_frames(PyObject *self, PyObject *frames) {
 
 static PyObject *codec_configure(PyObject *self, PyObject *args) {
     (void)self;
-    PyObject *ibt, *tbi, *fbi, *eb, *db;
-    if (!PyArg_ParseTuple(args, "OOOOO", &ibt, &tbi, &fbi, &eb, &db))
+    PyObject *ibt, *tbi, *fbi, *eb, *db, *obi = NULL;
+    if (!PyArg_ParseTuple(args, "OOOOO|O", &ibt, &tbi, &fbi, &eb, &db,
+                          &obi))
         return NULL;
     Py_XDECREF(g_id_by_type); Py_INCREF(ibt); g_id_by_type = ibt;
     Py_XDECREF(g_type_by_id); Py_INCREF(tbi); g_type_by_id = tbi;
     Py_XDECREF(g_fields_by_id); Py_INCREF(fbi); g_fields_by_id = fbi;
     Py_XDECREF(g_encode_body); Py_INCREF(eb); g_encode_body = eb;
     Py_XDECREF(g_decode_body); Py_INCREF(db); g_decode_body = db;
+    Py_XDECREF(g_optional_by_id); Py_XINCREF(obi); g_optional_by_id = obi;
     Py_RETURN_NONE;
 }
 
 static PyMethodDef codec_methods[] = {
     {"configure", codec_configure, METH_VARARGS,
      "configure(id_by_type, type_by_id, fields_by_id, encode_body, "
-     "decode_body) — bind the live registries + fallback hooks."},
+     "decode_body[, optional_by_id]) — bind the live registries + "
+     "fallback hooks."},
     {"encode", codec_encode, METH_O, "encode(obj) -> bytes"},
     {"decode", codec_decode, METH_O, "decode(bytes) -> obj"},
     {"decode_frames", codec_decode_frames, METH_O,

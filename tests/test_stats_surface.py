@@ -103,10 +103,18 @@ async def test_traces_route_shows_spans():
         assert traces, "no traces served"
         names = {s["name"] for t in traces for s in t["spans"]}
         assert "client.submit" in names
-        assert "server.commit" in names
+        # new causal vocabulary (docs/OBSERVABILITY.md): the commit side
+        # is the coarse group.commit on the single lane, or the
+        # quorum.wait/apply split on the block lanes
+        assert names & {"group.commit", "apply"}, names
         text = (await fetch_stats(f"127.0.0.1:{port}",
                                   "/traces.txt")).decode()
-        assert "server.append" in text
+        assert "group.append" in text
+        # the per-trace collection route serves this member's spans
+        tid = traces[0]["trace"]
+        local = json.loads(await fetch_stats(f"127.0.0.1:{port}",
+                                             f"/traces/{tid}"))
+        assert local["trace"] == tid and local["spans"], local
     finally:
         tracing.disable()
         tracing.TRACER.clear()

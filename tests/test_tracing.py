@@ -22,7 +22,7 @@ def _clean_tracer():
     tracing.TRACER.clear()
 
 
-def test_tracer_ring_buffer_evicts_oldest():
+def test_tracer_ring_buffer_evicts_oldest_and_tombstones():
     t = Tracer(capacity=3)
     t.enabled = True
     ids = [t.new_trace() for _ in range(5)]
@@ -31,7 +31,19 @@ def test_tracer_ring_buffer_evicts_oldest():
     kept = t.traces()
     assert len(kept) == 3
     assert set(kept) == set(ids[-3:])
-    # a span for an evicted id re-admits it (remote ids arrive late)
+    # a late span for an EVICTED id is dropped (tombstoned), never
+    # resurrected as a partial trace that would pollute dump_slowest
+    # with a nonsense total
+    t.span(ids[0], "late", 0.0, 0.5)
+    assert ids[0] not in t.traces()
+    assert t.spans_for(ids[0]) == []
+    # a genuinely new id is still admitted
+    fresh = t.new_trace()
+    t.span(fresh, "work", 0.0, 0.001)
+    assert fresh in t.traces()
+    # clear() resets the tombstones too: the id becomes recordable
+    # again (a fresh test/process epoch)
+    t.clear()
     t.span(ids[0], "late", 0.0, 0.5)
     assert ids[0] in t.traces()
 
@@ -87,15 +99,30 @@ async def test_trace_ids_survive_the_wire_roundtrip():
             names = {s.name for s in traces[tid]}
             # server-side spans recorded under the CLIENT's id: the id
             # survived request serialization and handler dispatch
-            assert "server.append" in names, names
-            assert "server.commit" in names, names
+            # (vocabulary: docs/OBSERVABILITY.md — the single lane
+            # records the coarse group.commit, the batch fast lane the
+            # quorum.wait/apply split)
+            assert "group.append" in names, names
+            assert names & {"group.commit", "apply"}, names
+            # every server-side span is member+group tagged for the
+            # cross-member assembly
+            for s in traces[tid]:
+                if s.name.startswith(("group.", "quorum.", "apply",
+                                      "respond", "follower.")):
+                    assert (s.meta or {}).get("member"), s
+                    assert "group" in (s.meta or {}), s
         # the batch trace carries the batch size through to its spans
         batch = [spans for spans in traces.values()
                  for s in spans
                  if s.name == "client.submit" and (s.meta or {}).get("n") == 2]
         assert batch, "batch submit span missing"
+        # a 3-member cluster replicates the traced entry: the window
+        # carried the id and the followers recorded their ingest
+        followers = [s for spans in traces.values() for s in spans
+                     if s.name == "follower.append"]
+        assert followers, "no follower.append spans landed"
         # and the dump renders them
-        assert "server.commit" in tracing.TRACER.dump_slowest(5)
+        assert "group.append" in tracing.TRACER.dump_slowest(5)
     finally:
         await cluster.close()
 
