@@ -1836,6 +1836,41 @@ def run_host_read() -> dict:
     }
 
 
+def _artifact_meta() -> dict:
+    """Attribution block for ``--metrics-json`` artifacts (schema in
+    docs/OBSERVABILITY.md "Bench artifacts"): the git SHA, the explicit
+    knob overrides, and a host fingerprint — without these two artifacts
+    are not comparable (a different host or knob set is a different
+    experiment, not a regression; the bench-baseline CI gate keys off
+    this block when explaining a miss)."""
+    import platform
+    import subprocess
+
+    sha = None
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+        if out.returncode == 0:
+            sha = out.stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return {
+        "git_sha": sha,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "knobs": knobs.overrides(),
+        "host": {
+            "hostname": platform.node(),
+            "machine": platform.machine(),
+            "python": platform.python_version(),
+            "cpus": os.cpu_count(),
+            "backend": jax.default_backend(),
+            "device_count": jax.device_count(),
+        },
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(prog="copycat-bench")
     parser.add_argument(
@@ -1911,6 +1946,7 @@ def main() -> None:
     if args.metrics_json:
         with open(args.metrics_json, "w") as f:
             json.dump({**result, "scenario": SCENARIO,
+                       "meta": _artifact_meta(),
                        "metrics": METRICS_SNAPSHOTS}, f)
         log(f"bench: metrics snapshot written to {args.metrics_json}")
     print(json.dumps(result))

@@ -31,8 +31,9 @@ def _server_parser() -> argparse.ArgumentParser:
                              "(default 127.0.0.1:5001)")
     parser.add_argument("--stats-port", type=int, default=None,
                         metavar="PORT",
-                        help="serve /stats (JSON), /metrics (Prometheus) "
-                             "and /traces on this port (0 = ephemeral)")
+                        help="serve /stats (JSON), /metrics (Prometheus), "
+                             "/health, /healthz and /traces on this port "
+                             "(0 = ephemeral)")
     parser.add_argument("--stats-host", default="127.0.0.1", metavar="HOST",
                         help="stats listener bind host (default loopback; "
                              "the surface is unauthenticated — widen "
@@ -93,7 +94,8 @@ async def _serve(args: argparse.Namespace) -> None:
         print(f"server listening at {address} (log: {log_dir})", flush=True)
         if server.stats is not None:
             print(f"stats listener on port {server.stats.port} "
-                  f"(/stats /metrics /traces)", flush=True)
+                  f"(/stats /metrics /health /healthz /traces)",
+                  flush=True)
         await stop.wait()
         print("shutting down...", flush=True)
     finally:
@@ -244,7 +246,8 @@ def _stats(args: argparse.Namespace) -> int:
     watch = getattr(args, "watch", None)
     path = {"stats": "/stats", "metrics": "/metrics",
             "traces": "/traces" if watch is not None else "/traces.txt",
-            "flight": "/flight.txt", "all": "/stats"}[args.what]
+            "flight": "/flight.txt", "health": "/health",
+            "all": "/stats"}[args.what]
 
     def fetch(p: str = path) -> bytes | None:
         try:
@@ -264,7 +267,8 @@ def _stats(args: argparse.Namespace) -> int:
             print(json.dumps(json.loads(body), indent=2, sort_keys=True))
             for title, p in (("metrics", "/metrics"),
                              ("traces", "/traces.txt"),
-                             ("flight", "/flight.txt")):
+                             ("flight", "/flight.txt"),
+                             ("health", "/health")):
                 extra = fetch(p)
                 if extra is not None:
                     print(f"=== {title} ===")
@@ -313,6 +317,21 @@ def _stats(args: argparse.Namespace) -> int:
         return 0
 
 
+def _bad_addresses(addresses: list[str]) -> int:
+    """Reject malformed ``host:port`` arguments up front with a one-line
+    actionable error (0 = all fine). Without this, a forgotten port
+    would read as 'member unreachable' and degrade a doctor/trace run
+    to an incomplete report with a partition/crash diagnosis — hiding a
+    typo behind a scarier story."""
+    bad = [a for a in addresses if not a.rpartition(":")[2].isdigit()]
+    if bad:
+        print(f"copycat-tpu: bad address(es) {', '.join(bad)} — expected "
+              f"host:port (the server's --stats-port endpoint)",
+              file=sys.stderr)
+        return 2
+    return 0
+
+
 def _trace(args: argparse.Namespace) -> int:
     """``copycat-tpu trace addr [addr...]``: assemble cross-member
     causal waterfalls (docs/OBSERVABILITY.md "Cluster-wide causal
@@ -324,6 +343,10 @@ def _trace(args: argparse.Namespace) -> int:
     are rendered, never dropped."""
     from .server.stats import fetch_stats
     from .utils.tracing import assemble_trace, render_waterfall
+
+    rc = _bad_addresses(args.addresses)
+    if rc:
+        return rc
 
     async def fetch(address: str, path: str) -> bytes | None:
         try:
@@ -378,11 +401,85 @@ def _trace(args: argparse.Namespace) -> int:
     return 0
 
 
+async def collect_doctor(addresses: list[str], slowest: int = 3
+                         ) -> tuple[dict, list, list]:
+    """The doctor's fan-out (exposed for tests): every member's
+    ``/health`` + ``/flight`` + ``/stats`` gathered in parallel, plus
+    the slowest traces from the first reachable member. Returns
+    ``(members, failed, slowest_traces)`` where ``members`` maps each
+    REACHED address to its payloads and ``failed`` lists the
+    unreachable ones — partial fan-outs assemble an incomplete report,
+    never a dropped one."""
+    from .server.stats import fetch_stats
+
+    async def fetch_json(address: str, path: str):
+        try:
+            return json.loads(await fetch_stats(address, path))
+        except (OSError, RuntimeError, ValueError, asyncio.TimeoutError):
+            return None
+
+    async def member(address: str):
+        health, flight, stats = await asyncio.gather(
+            fetch_json(address, "/health"),
+            fetch_json(address, "/flight"),
+            fetch_json(address, "/stats"))
+        return address, health, flight, stats
+
+    rows = await asyncio.gather(*(member(a) for a in addresses))
+    members: dict = {}
+    failed: list = []
+    for address, health, flight, stats in rows:
+        if health is None and flight is None and stats is None:
+            failed.append(address)
+            continue
+        members[address] = {"health": health, "flight": flight,
+                            "stats": stats}
+    traces: list = []
+    for address in members:
+        body = await fetch_json(address, "/traces")
+        if body is not None:
+            traces = sorted(body, key=lambda t: -t.get("total_ms", 0.0)
+                            )[:slowest]
+            break
+    return members, failed, traces
+
+
+def _doctor(args: argparse.Namespace) -> int:
+    """``copycat-tpu doctor addr [addr...]``: fan out to every member's
+    stats listener, correlate ``/health`` + ``/flight`` + ``/stats`` +
+    slowest traces across members, and render a root-cause report
+    (docs/OBSERVABILITY.md "Health & diagnosis"). Unreachable members
+    mark the report ``incomplete`` — partial reports render, never
+    drop; a fully unreachable cluster is a one-line error + exit 1."""
+    from .utils.health import assemble_doctor_report, render_doctor_report
+
+    rc = _bad_addresses(args.addresses)
+    if rc:
+        return rc
+    members, failed, traces = asyncio.run(
+        collect_doctor(args.addresses, args.slowest))
+    if not members:
+        print(f"copycat-tpu doctor: none of {len(args.addresses)} "
+              f"member(s) reachable ({', '.join(args.addresses)})\n"
+              f"(are the servers running with --stats-port?)",
+              file=sys.stderr)
+        return 1
+    report = assemble_doctor_report(members, failed_members=failed,
+                                    slowest_traces=traces)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render_doctor_report(report))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> None:
     """``copycat-tpu <verb>``: ``stats <host:port>`` reads a running
     server's observability surface; ``trace`` assembles cross-member
-    causal waterfalls; ``serve`` is ``copycat-server``; ``lint`` runs
-    the copycheck static-analysis suite (jax-free — docs/ANALYSIS.md)."""
+    causal waterfalls; ``doctor`` correlates every member's health +
+    black-box + traces into a root-cause report; ``serve`` is
+    ``copycat-server``; ``lint`` runs the copycheck static-analysis
+    suite (jax-free — docs/ANALYSIS.md)."""
     raw = sys.argv[1:] if argv is None else argv
     if raw and raw[0] == "lint":
         # copycheck owns its own argparse surface (docs/ANALYSIS.md);
@@ -399,11 +496,12 @@ def main(argv: list[str] | None = None) -> None:
                        help="the server's --stats-port endpoint")
     stats.add_argument("--what",
                        choices=("stats", "metrics", "traces", "flight",
-                                "all"),
+                                "health", "all"),
                        default="stats",
                        help="stats = JSON snapshot (default), metrics = "
                             "Prometheus text, traces = slowest requests, "
                             "flight = device-plane flight recorder, "
+                            "health = the detector verdict, "
                             "all = every surface in one shot (watch mode "
                             "polls the JSON snapshot's delta view)")
     stats.add_argument("--watch", type=float, default=None, metavar="N",
@@ -430,6 +528,20 @@ def main(argv: list[str] | None = None) -> None:
                        help="emit the assemblies as JSON instead of "
                             "the rendered waterfalls")
 
+    doctor = sub.add_parser(
+        "doctor", help="correlate every member's /health, /flight and "
+                       "/stats into a cross-member root-cause report")
+    doctor.add_argument("addresses", nargs="+", metavar="host:port",
+                        help="stats endpoints of the members to "
+                             "diagnose; unreachable members mark the "
+                             "report incomplete (never dropped)")
+    doctor.add_argument("--slowest", type=int, default=3, metavar="N",
+                        help="slowest traces to attach to the report "
+                             "(default 3)")
+    doctor.add_argument("--json", action="store_true",
+                        help="emit the report as JSON (the CI artifact "
+                             "shape) instead of the rendered text")
+
     serve = sub.add_parser("serve", help="run a standalone server node")
     serve.add_argument("rest", nargs=argparse.REMAINDER)
 
@@ -445,5 +557,7 @@ def main(argv: list[str] | None = None) -> None:
         raise SystemExit(_stats(args))
     if args.verb == "trace":
         raise SystemExit(_trace(args))
+    if args.verb == "doctor":
+        raise SystemExit(_doctor(args))
     if args.verb == "serve":
         server(args.rest)

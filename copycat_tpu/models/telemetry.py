@@ -118,12 +118,24 @@ class FlightRecorder:
     def __init__(self, capacity: int = 256) -> None:
         self._ring: deque = deque(maxlen=max(1, capacity))
         self._seq = 0
+        #: optional durable spill (``utils/health.py::BlackBox``): when
+        #: the health plane wires it, every ring event is also appended
+        #: to the crash-surviving on-disk black-box — so the events
+        #: leading up to a SIGKILL are readable after the restart.
+        #: ``None`` (the default, and the COPYCAT_HEALTH=0 plane) keeps
+        #: the ring memory-only, exactly the pre-health behavior.
+        self.spill = None
 
     def record(self, kind: str, round_no: int, **fields) -> dict:
         self._seq += 1
         event = {"seq": self._seq, "t": round(time.time(), 3),
                  "round": int(round_no), "kind": kind, **fields}
         self._ring.append(event)
+        if self.spill is not None:
+            try:
+                self.spill(event)
+            except Exception:  # noqa: BLE001 - spill must never wound
+                pass
         return event
 
     def events(self) -> list[dict]:

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from typing import Any, Callable
 
@@ -41,6 +42,7 @@ from ..io.transport import Address, Connection, Transport, TransportError
 from ..protocol import messages as msg
 from ..protocol.operations import QueryConsistency
 from ..utils import knobs
+from ..utils.health import BlackBox, HealthMonitor
 from ..utils.managed import Managed
 from ..utils.metrics import MetricsRegistry
 from ..utils.tracing import TRACER
@@ -140,6 +142,28 @@ class RaftServer(Managed):
         # pre-refactor names/values are preserved exactly.
         self._metrics = metrics or MetricsRegistry()
 
+        # Health plane (docs/OBSERVABILITY.md "Health & diagnosis"):
+        # online anomaly detectors at a fixed cadence + the durable
+        # black-box spill, created BEFORE the groups so boot-recovery
+        # anomalies (corrupt meta, failed restores) already land in the
+        # black-box. COPYCAT_HEALTH=0 removes all of it — no monitor
+        # task, no health.* keys, no black-box file, no fsync timing —
+        # the pre-health plane bit-identically (A/B).
+        self._health_enabled = knobs.get_bool("COPYCAT_HEALTH")
+        self._proxy_inflight = 0
+        self.blackbox: BlackBox | None = None
+        self.health: HealthMonitor | None = None
+        if self._health_enabled:
+            if self.storage.directory:
+                self.blackbox = BlackBox(os.path.join(
+                    self.storage.directory,
+                    f"{self.name}-{self.address.port}.blackbox"))
+                if self.blackbox.recovered:
+                    self.blackbox.record(
+                        "boot",
+                        recovered_events=len(self.blackbox.recovered))
+            self.health = HealthMonitor(self)
+
         def build_machine(g: int) -> StateMachine:
             if callable(state_machine) \
                     and not isinstance(state_machine, StateMachine):
@@ -197,18 +221,30 @@ class RaftServer(Managed):
             await self._join_cluster()
         for grp in self.groups:
             grp.start()
+        if self.health is not None:
+            self.health.start()
         logger.info("%s listening at %s (members=%s, groups=%d)", self.name,
                     self.address, self.groups[0].members, self.num_groups)
 
     async def _do_close(self) -> None:
         self._closing = True
+        if self.health is not None:
+            self.health.stop()
         for grp in self.groups:
             grp.shutdown()
         await self._server.close()
         await self._client.close()
         self._peer_connections.clear()
+        if self.blackbox is not None:
+            self.blackbox.close()
 
     def _cancel_timers(self) -> None:
+        # crash_server (testing/nemesis.py) calls this for its
+        # SIGKILL-shaped stop: the health pump dies with the process too
+        # (the black-box file handle is deliberately NOT closed — a
+        # crash leaves whatever the last flush wrote, nothing more)
+        if self.health is not None:
+            self.health.stop()
         for grp in self.groups:
             grp._cancel_timers()
 
@@ -500,6 +536,18 @@ class RaftServer(Managed):
         ``trace`` (the originating trace id) rides the ProxyRequest's
         optional trailing field; each wire attempt records a
         ``proxy.hop`` span (failed attempts tagged ``error=``)."""
+        # in-flight accounting feeds the health plane's ingress-backlog
+        # detector: sub-requests parked in the retry loop (a saturated
+        # or unreachable group leader) are exactly the backlog
+        self._proxy_inflight += 1
+        try:
+            return await self._proxy_dispatch(g, kind, payload, trace)
+        finally:
+            self._proxy_inflight -= 1
+
+    async def _proxy_dispatch(self, g: int, kind: str, payload: Any,
+                              trace: int | None = None
+                              ) -> msg.ProxyResponse:
         grp = self.groups[g]
         backoff = 0.01
         # the per-try budget must cover COMMIT latency, not just the
@@ -868,6 +916,65 @@ class RaftServer(Managed):
     # ------------------------------------------------------------------
     # observability (docs/OBSERVABILITY.md)
     # ------------------------------------------------------------------
+
+    def metrics_server_registry(self) -> MetricsRegistry:
+        """The SERVER-level registry object (shared with group 0 on the
+        single-group plane) — where the health monitor registers the
+        ``health.*`` family, so it rides every snapshot un-labeled."""
+        return self._metrics
+
+    def health_sample(self) -> dict:
+        """Server-scope sample for the health monitor (the per-group
+        half is ``RaftGroup.health_sample``): the ingress/proxy plane's
+        backlog signals."""
+        return {
+            "proxy_inflight": self._proxy_inflight,
+            "event_backlog": sum(
+                len(s.event_queue) for grp in self.groups
+                for s in grp.sessions.values()),
+        }
+
+    def device_flight(self) -> tuple[Any, int]:
+        """``(flight ring, current engine round)`` when the server runs
+        the TPU executor with an instantiated, telemetry-enabled engine
+        (raw ``_engine`` read — never trigger the lazy jit build);
+        ``(None, 0)`` otherwise. All groups share one engine
+        (docs/SHARDING.md), so group 0's is THE hub."""
+        engine = getattr(self.groups[0].state_machine, "_engine", None)
+        groups = getattr(engine, "_groups", None)
+        hub = getattr(groups, "telemetry", None)
+        if hub is None:
+            return None, 0
+        return hub.flight, getattr(groups, "rounds", 0)
+
+    def _attach_flight_spill(self) -> None:
+        """Lazily wire the flight ring's spill to the black-box (the
+        engine is built lazily): nemesis faults, invariant violations
+        and telemetry notes recorded into the ring then also survive a
+        crash. The ONE place the wiring lives — health_note and the
+        monitor's tick both route through here."""
+        flight, _ = self.device_flight()
+        if flight is not None and flight.spill is None \
+                and self.blackbox is not None:
+            flight.spill = self.blackbox.spill_event
+
+    def health_note(self, kind: str, group: int | None = None,
+                    **fields) -> None:
+        """Durable health note: into the device flight ring when an
+        engine hub exists (its spill forwards to the black-box), else
+        straight to the black-box. Never raises — observability must
+        never wound the server."""
+        try:
+            if group is not None:
+                fields["group"] = group
+            self._attach_flight_spill()
+            flight, rounds = self.device_flight()
+            if flight is not None:
+                flight.record(kind, rounds, **fields)
+            elif self.blackbox is not None:
+                self.blackbox.record(kind, **fields)
+        except Exception:  # noqa: BLE001
+            pass
 
     @property
     def metrics(self) -> MetricsRegistry:

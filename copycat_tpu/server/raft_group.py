@@ -145,7 +145,7 @@ class _PeerStream:
 
     __slots__ = ("window", "floor", "ceiling", "inflight_windows",
                  "inflight_entries", "epoch", "backoff", "ack_ewma_ms",
-                 "tasks")
+                 "floor_hits", "tasks")
 
     def __init__(self, ceiling: int) -> None:
         self.ceiling = max(1, ceiling)
@@ -156,13 +156,22 @@ class _PeerStream:
         self.epoch = 0
         self.backoff = False  # driver sleeps one beat before resuming
         self.ack_ewma_ms = 0.0
+        #: times congestion drove the window down TO its floor — a
+        #: cumulative counter because the pinned state itself is
+        #: transient (AIMD regrows once the EWMA re-baselines) and a
+        #: sampled gauge would miss it; the health plane's
+        #: window-collapse detector judges deltas of this
+        self.floor_hits = 0
         self.tasks: set[asyncio.Task] = set()
 
     def observe_ack(self, lat_ms: float) -> None:
         if self.ack_ewma_ms == 0.0:
             self.ack_ewma_ms = lat_ms
         if lat_ms > 4.0 * max(self.ack_ewma_ms, 0.1):
-            self.window = max(self.floor, self.window // 2)
+            shrunk = max(self.floor, self.window // 2)
+            if shrunk <= self.floor and self.window > self.floor:
+                self.floor_hits += 1
+            self.window = shrunk
         elif self.window < self.ceiling:
             self.window = min(self.ceiling,
                               self.window + max(1, self.ceiling // 8))
@@ -283,6 +292,7 @@ class RaftGroup:
         self._m_snap_restores = m.counter("snap.restores")
         self._m_snap_restore_ms = m.histogram("snap.restore_ms")
         self._m_snap_meta_fallback = m.counter("snap.meta_fallbacks")
+        self._m_snap_capture_fail = m.counter("snap.capture_failures")
         # Per-phase commit-latency attribution (docs/OBSERVABILITY.md
         # "Cluster-wide causal tracing"): fed ONLY by traced requests —
         # the client's trace flag is the sampling switch, so the
@@ -315,6 +325,15 @@ class RaftGroup:
         self._trace_entry_marks: dict[int, int] = {}
         self._member = str(self.address)
         self._trace_slow_ms = knobs.get_float("COPYCAT_TRACE_SLOW_MS")
+
+        # health-plane fsync accounting (utils/health.py): cheap EWMA +
+        # per-window max over the commit-boundary fsyncs, fed only when
+        # the server's health plane is on (COPYCAT_HEALTH=0 keeps the
+        # bare log.sync() calls — the A/B discipline)
+        self._fsync_count = 0
+        self._fsync_last_ms = 0.0
+        self._fsync_ewma_ms = 0.0
+        self._fsync_recent_max_ms = 0.0
 
         # crash-recovery plane (per group: own snapshot store + meta file)
         self._snapshots: SnapshotStore | None = None
@@ -526,15 +545,36 @@ class RaftGroup:
     def _flight_note(self, kind: str, **fields) -> None:
         """Best-effort note in the device-plane flight recorder (the ring
         ``testing/nemesis.py`` faults also land in), so a recovery anomaly
-        sits next to whatever fault caused it in one /flight dump."""
-        try:
-            engine = getattr(self.state_machine, "_engine", None)
-            groups = getattr(engine, "_groups", None)
-            hub = getattr(groups, "telemetry", None)
-            if hub is not None:
-                hub.flight.record(kind, getattr(groups, "rounds", 0), **fields)
-        except Exception:  # noqa: BLE001 - observability must never wound
-            pass
+        sits next to whatever fault caused it in one /flight dump. With
+        the health plane on, the note also lands in the durable black-box
+        so it survives a crash — all via the server's ``health_note``
+        (one implementation of the hub-else-blackbox + spill wiring)."""
+        self.server.health_note(
+            kind, group=None if self.server.single else self.group_id,
+            **fields)
+
+    def _note_fsync(self, ms: float) -> None:
+        """Health-plane fsync accounting: last/max/EWMA of the
+        commit-boundary fsync latency (the fsync-spike detector's
+        input; ``fsync_recent_max`` is consumed by ``health_sample``)."""
+        self._fsync_count += 1
+        self._fsync_last_ms = ms
+        if ms > self._fsync_recent_max_ms:
+            self._fsync_recent_max_ms = ms
+        self._fsync_ewma_ms = (
+            ms if self._fsync_ewma_ms == 0.0
+            else self._fsync_ewma_ms + 0.1 * (ms - self._fsync_ewma_ms))
+
+    def _sync_log(self) -> None:
+        """Commit-boundary ``log.sync()`` with health-plane latency
+        accounting; COPYCAT_HEALTH=0 keeps the bare sync (not even the
+        clock reads) — the A/B lane."""
+        if not self.server._health_enabled:
+            self.log.sync()
+            return
+        t0 = time.perf_counter()
+        self.log.sync()
+        self._note_fsync((time.perf_counter() - t0) * 1e3)
 
     # ------------------------------------------------------------------
     # snapshot capture / restore (crash-recovery plane)
@@ -607,6 +647,7 @@ class RaftGroup:
             # that called us must keep running either way
             logger.exception("%s snapshot capture at %d failed", self.name,
                              index)
+            self._m_snap_capture_fail.inc()
             self._flight_note("snapshot_failed", index=index)
             return False
         logger.debug("%s snapshot at %d (%d bytes, %d entries released)",
@@ -1371,12 +1412,14 @@ class RaftGroup:
                     t_s = time.perf_counter()
                     self.log.sync()
                     t_e = time.perf_counter()
+                    if self.server._health_enabled:
+                        self._note_fsync((t_e - t_s) * 1e3)
                     for trace in hit:
                         self._trace_span(trace, "group.fsync", t_s, t_e,
                                          self._m_lat_fsync)
                         self._trace_commit_t[trace] = t_e
                 else:
-                    self.log.sync()  # commit boundary: ack = durable
+                    self._sync_log()  # commit boundary: ack = durable
             self._apply_up_to(self.commit_index)
         # global index: minimum replicated position across all members
         if self.peers:
@@ -1563,7 +1606,7 @@ class RaftGroup:
                 # acknowledged commit (a quorum of un-fsynced ackers
                 # reboots without the entry and re-elects among
                 # themselves) — sync BEFORE acking, per append window
-                self.log.sync()
+                self._sync_log()
 
         fill_to = request.fill_to or 0
         if fill_to > self.log.last_index:
@@ -1581,7 +1624,7 @@ class RaftGroup:
         if commit > self.commit_index:
             self.commit_index = commit
             if self._fsync_on_commit:
-                self.log.sync()  # commit boundary: acknowledged = durable
+                self._sync_log()  # commit boundary: acknowledged = durable
             self._apply_up_to(commit)
         global_index = getattr(request, "global_index", None)
         if global_index:
@@ -3063,6 +3106,37 @@ class RaftGroup:
     # ------------------------------------------------------------------
     # observability
     # ------------------------------------------------------------------
+
+    def health_sample(self) -> dict:
+        """One point-in-time sample for the health monitor's detectors
+        (``utils/health.py``): cursors, churn counters, replication
+        stream windows, fsync latency accounting, and session-plane
+        signals. ``fsync_max_ms`` is consume-on-read: the max since the
+        previous sample."""
+        m = self.metrics
+        recent = self._fsync_recent_max_ms
+        self._fsync_recent_max_ms = 0.0
+        return {
+            "role": self.role,
+            "term": self.term,
+            "commit_index": self.commit_index,
+            "last_applied": self.last_applied,
+            "log_last_index": self.log.last_index,
+            "elections": m.counter("raft_elections_started").value,
+            "transitions": m.counter("raft_leader_transitions").value,
+            "rewinds": self._m_repl_rewinds.value,
+            "stalls": self._m_repl_stalls.value,
+            "repl_windows": {str(p): (s.window, s.floor, s.floor_hits)
+                             for p, s in self._peer_streams.items()},
+            "fsyncs": self._fsync_count,
+            "fsync_max_ms": recent,
+            "fsync_ewma_ms": self._fsync_ewma_ms,
+            "sessions_expired": m.counter("sessions_expired_total").value,
+            "event_backlog": sum(len(s.event_queue)
+                                 for s in self.sessions.values()),
+            "snap_failures": (self._m_snap_capture_fail.value
+                              + self._m_snap_install_fail.value),
+        }
 
     def refresh_gauges(self) -> None:
         """Refresh this group's lazy point-in-time gauges (term/role/lag/

@@ -14,6 +14,12 @@ Routes:
 - ``/metrics`` — Prometheus text exposition: the raft registry under
   ``copycat_*``, the transport's under ``copycat_transport_*``, the
   resource manager's under ``copycat_manager_*``.
+- ``/health`` — the health plane's verdict (``utils/health.py``): a
+  fresh detector evaluation — status/reasons/per-group breakdown with
+  the evidence series attached; ``{"status": "disabled"}`` under
+  ``COPYCAT_HEALTH=0``.
+- ``/healthz`` — minimal liveness: 200 + role/term only, no snapshot
+  cost — safe for high-frequency probes.
 - ``/traces`` — JSON dump of the slowest traced requests
   (``utils/tracing.py``); ``/traces.txt`` for the human rendering.
 - ``/traces/<id>`` — THIS member's spans for one trace id: the
@@ -24,6 +30,9 @@ Routes:
   ``models/telemetry.py``); ``/flight.txt`` for the human rendering.
   Active when the server runs the TPU executor with telemetry on
   (``COPYCAT_TELEMETRY=1`` / ``DeviceEngineConfig(telemetry=True)``).
+  With the health plane on, also carries the durable black-box
+  (``utils/health.py``): the previous life's events reloaded at boot
+  and tagged ``recovered=true`` — what post-SIGKILL forensics read.
 
 Enable with ``AtomixServer(..., stats_port=N)`` /
 ``copycat-server --stats-port N``; read with ``copycat-tpu stats
@@ -120,6 +129,29 @@ class StatsListener:
     def _route(self, path: str) -> tuple[bytes, str]:
         if path == "/metrics":
             return self._prometheus().encode(), "text/plain; version=0.0.4"
+        if path == "/healthz":
+            # minimal liveness: role/term only, no snapshot refresh, no
+            # registry walk — safe to poll at any frequency
+            g0 = self._raft.groups[0]
+            return (json.dumps({
+                "ok": True, "node": str(self._raft.address),
+                "role": g0.role, "term": g0.term,
+            }).encode(), "application/json")
+        if path == "/health":
+            # the health plane's verdict (docs/OBSERVABILITY.md "Health
+            # & diagnosis"): rate-limited re-evaluation — at most one
+            # fresh tick per half-cadence, so a high-frequency probe
+            # cannot flood the evidence windows and shrink every delta
+            # detector's lookback (observing health must not suppress it)
+            monitor = getattr(self._raft, "health", None)
+            if monitor is None:
+                body = json.dumps({
+                    "status": "disabled",
+                    "node": str(self._raft.address),
+                    "note": "health plane off (COPYCAT_HEALTH=0)"})
+            else:
+                body = json.dumps(monitor.verdict())
+            return body.encode(), "application/json"
         if path == "/traces":
             return TRACER.dump_slowest(20, as_json=True).encode(), \
                 "application/json"
@@ -143,23 +175,46 @@ class StatsListener:
                 "spans": spans,
             }).encode(), "application/json")
         if path == "/flight":
+            # the in-memory ring (when a telemetry-enabled engine runs)
+            # PLUS the durable black-box: recovered events from the
+            # previous life ride under "blackbox" tagged recovered=true
+            # — the post-SIGKILL forensics surface `doctor` correlates
             hub = self._device_hub()
-            body = (hub.flight.render_json() if hub is not None
-                    else json.dumps({"events": [], "note":
-                                     "device-plane telemetry disabled "
-                                     "(COPYCAT_TELEMETRY=1 or "
-                                     "DeviceEngineConfig(telemetry=True))"}))
-            return body.encode(), "application/json"
+            payload: dict = {"events": (hub.flight.events()
+                                        if hub is not None else [])}
+            if hub is None:
+                payload["note"] = ("device-plane telemetry disabled "
+                                   "(COPYCAT_TELEMETRY=1 or "
+                                   "DeviceEngineConfig(telemetry=True))")
+            blackbox = getattr(self._raft, "blackbox", None)
+            if blackbox is not None:
+                payload["blackbox"] = {
+                    **blackbox.summary(),
+                    "recovered": blackbox.recovered,
+                    "events": blackbox.events(),
+                }
+            return json.dumps(payload).encode(), "application/json"
         if path == "/flight.txt":
             hub = self._device_hub()
             body = (hub.flight.render_text() if hub is not None
                     else "device-plane telemetry disabled\n")
+            blackbox = getattr(self._raft, "blackbox", None)
+            if blackbox is not None and blackbox.recovered:
+                body += (f"--- black-box: {len(blackbox.recovered)} "
+                         f"recovered event(s) from the previous life ---\n")
+                for ev in blackbox.recovered:
+                    extra = " ".join(f"{k}={v}" for k, v in ev.items()
+                                     if k not in ("seq", "t", "kind",
+                                                  "recovered"))
+                    body += (f"#{ev.get('seq', '?'):<5} "
+                             f"{ev.get('kind', '?'):<12} {extra}\n")
             return body.encode(), "text/plain"
         if path in ("/", "/stats", "/stats.json"):
             return json.dumps(self._raft.stats_snapshot()).encode(), \
                 "application/json"
         return (json.dumps({"error": f"unknown path {path}",
-                            "routes": ["/stats", "/metrics", "/traces",
+                            "routes": ["/stats", "/metrics", "/health",
+                                       "/healthz", "/traces",
                                        "/traces.txt", "/traces/<id>",
                                        "/flight", "/flight.txt"]}).encode(),
                 "application/json")
@@ -198,6 +253,12 @@ async def fetch_stats(address: str, path: str = "/stats",
     """Minimal HTTP GET against a stats listener (no external deps —
     what ``copycat-tpu stats`` uses). ``address`` is ``host:port``."""
     host, _, port = address.rpartition(":")
+    if not port.isdigit():
+        # a malformed address must be a one-line actionable error at the
+        # CLI, not an int() traceback
+        raise RuntimeError(
+            f"bad address {address!r} — expected host:port (the "
+            f"server's --stats-port endpoint)")
     reader, writer = await asyncio.wait_for(
         asyncio.open_connection(host or "127.0.0.1", int(port)), timeout)
     try:

@@ -118,6 +118,49 @@ async def crash_server(server) -> None:
     # state stays wherever the fsync policy last left it
 
 
+class SlowDiskNemesis:
+    """Inject fsync latency into a server's log(s): the slow/failing
+    disk the health plane's fsync-spike detector (and, via the
+    follower's pre-ack fsync, the leader's AIMD window collapse) exists
+    to catch. Wraps each group log's ``sync()`` with a blocking sleep —
+    blocking on purpose: a real slow fsync stalls the event loop the
+    same way."""
+
+    def __init__(self, server, delay_s: float = 0.02) -> None:
+        self._server = server
+        self.delay_s = delay_s
+        self._originals: list[tuple] = []
+
+    def install(self) -> None:
+        import time as _time
+
+        for group in getattr(self._server, "groups", None) or (self._server,):
+            log = group.log
+            original = log.sync
+
+            def slow_sync(_orig=original) -> None:
+                _time.sleep(self.delay_s)
+                _orig()
+
+            self._originals.append((log, original))
+            log.sync = slow_sync  # type: ignore[method-assign]
+        hub = self._hub()
+        if hub is not None:
+            hub.flight.record("fault", 0, fault="slow_disk",
+                              delay_s=self.delay_s)
+
+    def remove(self) -> None:
+        for log, original in self._originals:
+            log.sync = original  # type: ignore[method-assign]
+        self._originals.clear()
+
+    def _hub(self):
+        machine = getattr(self._server, "state_machine", None)
+        engine = getattr(machine, "_engine", None)
+        groups = getattr(engine, "_groups", None)
+        return getattr(groups, "telemetry", None)
+
+
 class StorageNemesis:
     """Crash/torn-write fault injection over one server's storage
     directory (the host-plane sibling of :class:`Nemesis`): mutates the

@@ -145,6 +145,40 @@ _knob("COPYCAT_INVARIANTS", "str", None, default_doc="unset (= observe)",
 _knob("COPYCAT_INVARIANT_LEADERLESS_MAX", "float", 1.0,
       "max leaderless-group fraction per fetched round before the "
       "monitor trips", section="observability")
+_knob("COPYCAT_HEALTH", "bool", True,
+      "`0` disables the health plane (online anomaly detectors, the "
+      "`/health` verdict, the durable black-box spill) — the A/B knob "
+      "restoring the pre-health plane bit-identically",
+      section="observability")
+_knob("COPYCAT_HEALTH_INTERVAL_S", "float", 1.0,
+      "detector cadence: seconds between health-monitor samples",
+      section="observability")
+_knob("COPYCAT_HEALTH_WINDOW", "int", 30,
+      "samples retained per evidence series (the detector lookback "
+      "window)", section="observability")
+_knob("COPYCAT_HEALTH_CHURN_WARN", "int", 3,
+      "elections + leader transitions per window that grade "
+      "leader-churn `warn` (2x grades `critical`)",
+      section="observability")
+_knob("COPYCAT_HEALTH_STALL_S", "float", 2.0,
+      "seconds the commit index may sit frozen behind the log tail "
+      "before commit-stall grades `warn` (growing lag grades "
+      "`critical`)", section="observability")
+_knob("COPYCAT_HEALTH_FSYNC_FACTOR", "float", 4.0,
+      "fsync latency vs the pre-window EWMA baseline that grades "
+      "fsync-spike `warn` (3x the factor grades `critical`)",
+      section="observability")
+_knob("COPYCAT_HEALTH_QUEUE_WARN", "int", 64,
+      "ingress/event backlog depth that grades ingress-backlog `warn` "
+      "when still growing (4x grades `critical`)",
+      section="observability")
+_knob("COPYCAT_HEALTH_EXPIRY_WARN", "int", 3,
+      "session expiries per window that grade expiry-storm `warn` "
+      "(3x grades `critical`)", section="observability")
+_knob("COPYCAT_BLACKBOX_BYTES", "int", 262144,
+      "black-box spill bytes per generation (two generations kept; "
+      "the crash-surviving flight-recorder ring on disk)",
+      section="observability")
 
 # --- client ----------------------------------------------------------------
 _knob("COPYCAT_CLIENT_FOLLOWER_READS", "bool", True,
@@ -412,6 +446,15 @@ def get_bool(name: str, default: bool | None = None) -> bool:
                 f"{name} has no registered default; pass default=")
         return bool(knob.default)
     return value.strip().lower() not in _FALSY
+
+
+def overrides() -> dict[str, str]:
+    """Every registered knob explicitly set in the environment, with its
+    raw value — the scenario knob snapshot ``bench.py --metrics-json``
+    embeds so artifacts from different runs are comparable (an artifact
+    whose knobs differ is a different experiment, not a regression)."""
+    return {name: os.environ[name] for name in sorted(REGISTRY)
+            if name in os.environ}
 
 
 # --- README generation -----------------------------------------------------
