@@ -49,6 +49,15 @@ Scenarios (``COPYCAT_BENCH_SCENARIO``, BASELINE.md benchmark configs):
   groups-led / per-group-commit / routing-mix in the artifact. The A/B
   knob is ``--groups 1`` (the single-group plane, which
   ``COPYCAT_MULTI_GROUP=0`` pins bit-identically).
+- ``apply``: the apply-limited scenario (docs/SHARDING.md "Apply
+  ordering") — a single member hosting ``--groups N`` Raft groups,
+  many sessions, hot/cold zipfian device counters, and an interleaved
+  eligible/ineligible op stream that collapses the contiguous vector
+  classifier to the per-entry lane; headline value is committed
+  ops/sec, with the ``apply.*`` family (spans, conflicts, fused
+  dispatches, rows/runs per dispatch) in the artifact. The A/B knobs
+  are ``COPYCAT_PARALLEL_APPLY=0`` / ``COPYCAT_APPLY_FUSE=0`` (the
+  contiguous/per-group plane).
 - ``recovery``: the crash-recovery scenario — a fresh member catching up
   to a loaded, compacted cluster via snapshot-install streaming vs full
   log replay (``COPYCAT_SNAPSHOTS`` A/B inside one run); headline value
@@ -224,6 +233,27 @@ def percentiles(hist: np.ndarray, qs) -> list[int]:
         return [0 for _ in qs]
     cum = np.cumsum(hist)
     return [int(np.searchsorted(cum, q * total)) for q in qs]
+
+
+def zipf_sampler(rng, n_keys: int, s: float):
+    """Deterministic zipfian rank draw: inverse-CDF over 1/rank^s on
+    the caller's seeded ``rng``. Shared by the hot/cold-keyspace
+    scenarios (``sharded``, ``apply``) so their skew semantics cannot
+    drift apart; returns a 0-based rank in ``[0, n_keys)``."""
+    import bisect
+
+    weights = [1.0 / (r ** s) for r in range(1, n_keys + 1)]
+    total_w = sum(weights)
+    cdf = []
+    acc = 0.0
+    for w in weights:
+        acc += w / total_w
+        cdf.append(acc)
+
+    def draw() -> int:
+        return min(bisect.bisect_left(cdf, rng.random()), n_keys - 1)
+
+    return draw
 
 
 def empty_submits(G: int) -> Submits:
@@ -1248,24 +1278,10 @@ def run_sharded() -> dict:
 
     # zipfian key draw, deterministic: inverse-CDF over 1/rank^s
     rng = _random.Random(12)
-    weights = [1.0 / (r ** zipf_s) for r in range(1, n_keys + 1)]
-    total_w = sum(weights)
-    cdf = []
-    acc = 0.0
-    for w in weights:
-        acc += w / total_w
-        cdf.append(acc)
+    draw_rank = zipf_sampler(rng, n_keys, zipf_s)
 
     def draw_key() -> str:
-        x = rng.random()
-        lo, hi = 0, n_keys - 1
-        while lo < hi:
-            mid = (lo + hi) // 2
-            if cdf[mid] < x:
-                lo = mid + 1
-            else:
-                hi = mid
-        return f"user:{lo}"
+        return f"user:{draw_rank()}"
 
     async def drive() -> dict:
         registry = LocalServerRegistry()
@@ -1472,6 +1488,274 @@ def run_sharded() -> dict:
                     await asyncio.wait_for(s.close(), 10)
                 except Exception:
                     pass
+
+    return asyncio.run(drive())
+
+
+def run_apply() -> dict:
+    """Apply-limited bench (docs/SHARDING.md "Apply ordering"):
+    committed ops/sec through the public resource API on a single
+    member hosting ``--groups N`` Raft groups, many sessions, a
+    hot/cold zipfian key mix over device counters, and an INTERLEAVED
+    eligible/ineligible op stream — the shape that collapses the
+    contiguous vector classifier to the per-entry lane.
+
+    No replication wire, no nemesis delay: commit is immediate, so the
+    apply path IS the bottleneck. Eligible sessions stream single-
+    command ``get_and_set`` writes (device rows — deliberately NOT the
+    ``DistributedAtomicLong`` CAS-retry loop, whose client-side
+    contention on hot zipf keys would measure retry storms, not the
+    apply plane) against per-session instance handles of a SHARED zipf
+    keyspace; a ``COPYCAT_BENCH_APPLY_INELIGIBLE`` fraction of sessions
+    streams host-shadow STRING sets instead — every shadow entry is an
+    ineligible log entry interleaved between other sessions' device
+    rows. The A/B is this scenario with ``COPYCAT_PARALLEL_APPLY=0
+    COPYCAT_APPLY_FUSE=0`` (the contiguous/per-group plane): there each
+    interleaved ineligible entry CUTS the vector run (toward the
+    per-entry lane as the mix rises), while the dependency classifier
+    spans them — disjoint keys, disjoint sessions — and the fused lane
+    merges all groups' staged runs into ONE ``DeviceEngine.run_vector``
+    per server turn (``apply.*`` family in the artifact;
+    ``runs_per_dispatch`` ≈ groups is the one-device-round-per-turn
+    evidence)."""
+    import asyncio
+    import random as _random
+
+    from .atomic import DistributedAtomicValue
+    from .io.local import LocalServerRegistry, LocalTransport
+    from .io.transport import Address
+    from .manager.atomix import AtomixClient, AtomixServer
+    from .manager.device_executor import DeviceEngineConfig
+
+    groups = max(1, knobs.get_int("COPYCAT_BENCH_APPLY_GROUPS"))
+    n_sessions = knobs.get_int("COPYCAT_BENCH_APPLY_SESSIONS")
+    ops_per_session = knobs.get_int("COPYCAT_BENCH_APPLY_OPS")
+    bursts = knobs.get_int("COPYCAT_BENCH_APPLY_BURSTS")
+    n_keys = knobs.get_int("COPYCAT_BENCH_APPLY_KEYS")
+    zipf_s = knobs.get_float("COPYCAT_BENCH_APPLY_ZIPF")
+    ineligible = knobs.get_float("COPYCAT_BENCH_APPLY_INELIGIBLE")
+
+    # zipfian key draw, deterministic: inverse-CDF over 1/rank^s
+    rng = _random.Random(17)
+    draw_key = zipf_sampler(rng, n_keys, zipf_s)
+
+    capacity = 1 << max(4, (n_keys + n_sessions - 1).bit_length())
+
+    async def drive() -> dict:
+        registry = LocalServerRegistry()
+        (addr,) = (Address("local", 17500),)
+        server = AtomixServer(
+            addr, [addr], LocalTransport(registry),
+            election_timeout=0.5, heartbeat_interval=0.1,
+            session_timeout=120.0, executor="tpu", groups=groups,
+            engine_config=DeviceEngineConfig(
+                capacity=capacity, num_peers=3, log_slots=32,
+                submit_slots=8,
+                resource=ResourceConfig.counters_only()))
+        await server.open()
+        sessions = [AtomixClient([addr], LocalTransport(registry),
+                                 session_timeout=120.0)
+                    for _ in range(n_sessions)]
+        await asyncio.gather(*(c.open() for c in sessions))
+        rs = server.server
+        # a positive fraction always yields >= 1 shadow session (the
+        # interleave must exist to be measured); exactly 0 yields NONE —
+        # the pure-eligible datapoint that isolates fusion gain from
+        # spanning gain
+        n_shadow = 0 if ineligible <= 0 else min(
+            n_sessions - 1, max(1, round(n_sessions * ineligible)))
+        n_elig = n_sessions - n_shadow
+        try:
+            # Per-session instance handles to the SHARED zipf keyspace:
+            # instances of one value share a resource (and its device
+            # row), so two sessions writing key k are same-key dependent
+            # — the hot/cold mix — while every session still submits
+            # through its own connection and seq space.
+            handles = await asyncio.gather(*(
+                asyncio.gather(*(sessions[i].get(
+                    f"k{k}", DistributedAtomicValue)
+                    for k in range(n_keys)))
+                for i in range(n_elig)))
+            # Shadow value names brute-forced against the crc32 router
+            # so EVERY group's log interleaves ineligible entries —
+            # hash-luck leaving a group shadow-free would hand that
+            # group contiguous runs even on the knobs-off plane,
+            # measuring nothing.
+            import zlib as _zlib
+
+            def _shadow_name(j: int) -> str:
+                name, t = f"sh{j}", 0
+                while _zlib.crc32(name.encode()) % groups != j % groups:
+                    t += 1
+                    name = f"sh{j}x{t}"
+                return name
+
+            shadows = await asyncio.gather(
+                *(sessions[n_elig + j].get(
+                    _shadow_name(j), DistributedAtomicValue)
+                  for j in range(n_shadow)))
+            log(f"bench[apply]: 1 member x {groups} groups, "
+                f"{n_elig} device + {n_shadow} host-shadow sessions "
+                f"x {ops_per_session} ops/burst, zipf s={zipf_s} over "
+                f"{n_keys} keys, parallel_apply={rs._parallel_apply} "
+                f"fuse={rs._apply_fuse}")
+            _bench_gc_tune()
+
+            # Continuous submission under a bounded-in-flight window per
+            # session (no chunk barriers): barriers lock every session
+            # to the commit-turn cadence, collapsing the applied windows
+            # to a couple of entries each — a commit-latency bench, not
+            # an apply bench. A standing backlog keeps windows large.
+            # The shadow window is SHALLOW (2), deliberately: a
+            # contiguous flush of N ineligible entries cuts a
+            # contiguous-plane run once, not N times, so deep shadow
+            # pipelining hides the interleave the scenario exists to
+            # measure.
+            # Both lanes scatter each submission a few seeded
+            # ready-queue iterations deep before sending: sessions
+            # woken by the same ack wave otherwise submit in the ack
+            # order of the PREVIOUS window — a self-reinforcing pattern
+            # that parks every shadow entry at a window EDGE, where it
+            # cuts nothing and the interleave the scenario exists to
+            # measure never forms. The yields put shadow entries in the
+            # MIDDLE of device runs, log-order-for-real.
+            async def one_device(i: int, script: list) -> None:
+                h = handles[i]
+                sem = asyncio.Semaphore(8)
+
+                async def go(k: int, v: int, yields: int) -> None:
+                    async with sem:
+                        for _ in range(yields):
+                            await asyncio.sleep(0)
+                        await h[k].get_and_set(v)
+                await asyncio.gather(*(go(k, v, rng.randrange(8))
+                                       for k, v in script))
+
+            async def one_shadow(j: int, script: list) -> None:
+                sh = shadows[j]
+                sem = asyncio.Semaphore(2)
+
+                async def go(s: str, yields: int) -> None:
+                    async with sem:
+                        for _ in range(yields):
+                            await asyncio.sleep(0)
+                        await sh.set(s)
+                await asyncio.gather(*(go(s, rng.randrange(8))
+                                       for s in script))
+
+            # a shadow session's shallow (2-deep) stream covers ~1/4
+            # the ops of a pipelined (8-deep) device session in the
+            # same wall window — shorter scripts keep the two streams
+            # co-terminous, so the interleave lasts the whole burst
+            shadow_ops = max(2, ops_per_session // 4)
+            burst_ops = n_elig * ops_per_session + n_shadow * shadow_ops
+
+            # warmup wave (untimed, untraced): the first engine round
+            # pays jit compilation — hundreds of ms that would otherwise
+            # dominate BOTH planes' first rep and the apply-latency p99
+            await asyncio.gather(
+                *(one_device(i, [(draw_key(), 1)
+                                 for _ in range(ops_per_session // 2)])
+                  for i in range(n_elig)),
+                *(one_shadow(j, [f"w{j}x{t}"
+                                 for t in range(shadow_ops // 2)])
+                  for j in range(n_shadow)))
+
+            # Trace EVERY timed request (both A/B planes pay the same
+            # ≤2% overhead — PERF.md round 13): the latency.apply_ms
+            # phase histogram is the scenario's tail-latency judge —
+            # commit → commit-future resolved, exactly the window the
+            # parallel/fused plane compresses.
+            from .utils import tracing as _tracing
+            _tracing.TRACER.clear()
+            _tracing.enable()  # warmup above ran untraced: the phase
+            # histograms hold timed-burst samples only
+            reps = []
+            seq = 0
+            for rep in range(bursts):
+                escripts = [[(draw_key(), rng.randrange(1 << 20))
+                             for _ in range(ops_per_session)]
+                            for _ in range(n_elig)]
+                sscripts = []
+                for _ in range(n_shadow):
+                    script = []
+                    for _ in range(shadow_ops):
+                        seq += 1
+                        script.append(f"s{seq}")
+                    sscripts.append(script)
+                t0 = time.perf_counter()
+                await asyncio.gather(
+                    *(one_device(i, s) for i, s in enumerate(escripts)),
+                    *(one_shadow(j, s) for j, s in enumerate(sscripts)))
+                dt = time.perf_counter() - t0
+                ops = burst_ops / dt
+                reps.append(ops)
+                log(f"bench[apply]: rep {rep}: {burst_ops} committed ops "
+                    f"in {dt:.3f}s -> {ops:,.0f} ops/sec")
+            METRICS_SNAPSHOTS["server"] = rs.stats_snapshot()
+            METRICS_SNAPSHOTS["client"] = sessions[0].client \
+                .metrics.snapshot()
+            _tracing.disable()
+            # apply-phase tail latency (commit -> futures resolved) per
+            # group; the headline p99 is the worst group's — commands
+            # spread across groups, so one group's stalled apply IS the
+            # client-visible tail
+            lat = {}
+            for grp in rs.groups:
+                h = grp.metrics.histogram("latency.apply_ms")
+                if h.count:
+                    lat[str(grp.group_id)] = round(h.percentile(99), 3)
+            fused = rs._metrics.counter("apply.fused_dispatches").value
+            fused_rows = rs._metrics.histogram("apply.fused_rows")
+            fused_groups = rs._metrics.histogram("apply.fused_groups")
+            runs = spans = conflicts = vops = 0
+            for grp in rs.groups:
+                runs += grp.metrics.counter("vector_runs").value
+                vops += grp.metrics.counter("vector_ops").value
+                spans += grp.metrics.counter("apply.parallel_spans").value
+                conflicts += grp.metrics.counter(
+                    "apply.conflict_flushes").value
+            best = max(reps)
+            return {
+                "metric": (f"apply_committed_ops_per_sec_{n_sessions}"
+                           f"_sessions_{groups}_groups"),
+                "value": round(best, 1),
+                "unit": "ops/sec",
+                "vs_baseline": round(best / NORTH_STAR_OPS, 4),
+                "groups": groups,
+                "sessions": n_sessions,
+                "keys": n_keys,
+                "zipf_s": zipf_s,
+                "ineligible_fraction": ineligible,
+                "parallel_apply": rs._parallel_apply,
+                "apply_fuse": rs._apply_fuse,
+                "latency_apply_p99_ms": max(lat.values()) if lat else 0.0,
+                "latency_apply_p99_ms_per_group": lat,
+                "apply": {
+                    "vector_runs": runs,
+                    "vector_ops": vops,
+                    "parallel_spans": spans,
+                    "conflict_flushes": conflicts,
+                    "fused_dispatches": fused,
+                    "rows_per_dispatch": round(
+                        fused_rows.mean, 2) if fused else 0.0,
+                    "groups_per_dispatch": round(
+                        fused_groups.mean, 2) if fused else 0.0,
+                    "runs_per_dispatch": round(
+                        runs / fused, 2) if fused else 0.0,
+                },
+                **spread(reps),
+            }
+        finally:
+            for c in sessions:
+                try:
+                    await asyncio.wait_for(c.close(), 10)
+                except Exception:
+                    pass
+            try:
+                await asyncio.wait_for(server.close(), 10)
+            except Exception:
+                pass
 
     return asyncio.run(drive())
 
@@ -1884,15 +2168,17 @@ def main() -> None:
              "COPYCAT_BENCH_RECOVERY_STORAGE); the durability A/B knob")
     parser.add_argument(
         "--groups", default=None, type=int, metavar="N",
-        help="Raft groups for the sharded scenario (env "
-             "COPYCAT_BENCH_SHARDED_GROUPS); 1 = the single-group "
-             "baseline, the sharding A/B knob (docs/SHARDING.md)")
+        help="Raft groups for the sharded/apply scenarios (envs "
+             "COPYCAT_BENCH_SHARDED_GROUPS / COPYCAT_BENCH_APPLY_GROUPS);"
+             " 1 = the single-group baseline, the sharding A/B knob "
+             "(docs/SHARDING.md)")
     args, _ = parser.parse_known_args()
     if args.storage:
         os.environ["COPYCAT_BENCH_CLUSTER_STORAGE"] = args.storage
         os.environ["COPYCAT_BENCH_RECOVERY_STORAGE"] = args.storage
     if args.groups is not None:
         os.environ["COPYCAT_BENCH_SHARDED_GROUPS"] = str(args.groups)
+        os.environ["COPYCAT_BENCH_APPLY_GROUPS"] = str(args.groups)
     # Probe the accelerator before any in-process backend use — a dead
     # tunnel otherwise hangs device enumeration forever. When every
     # probe fails (BENCH_r05: rc=2 after 5 probes, a whole round's
@@ -1931,6 +2217,8 @@ def main() -> None:
         result = run_cluster()
     elif SCENARIO == "sharded":
         result = run_sharded()
+    elif SCENARIO == "apply":
+        result = run_apply()
     elif SCENARIO == "recovery":
         result = run_recovery()
     elif SCENARIO == "session":
@@ -1940,7 +2228,7 @@ def main() -> None:
     else:
         raise SystemExit(
             f"unknown scenario {SCENARIO!r}; pick one of "
-            f"{['election', 'map_read', 'host', 'host_read', 'spi', 'readmix', 'cluster', 'sharded', 'recovery', 'session', *SUBMIT_BUILDERS]}")
+            f"{['election', 'map_read', 'host', 'host_read', 'spi', 'readmix', 'cluster', 'sharded', 'apply', 'recovery', 'session', *SUBMIT_BUILDERS]}")
     if degraded:
         result["degraded"] = True
     if args.metrics_json:

@@ -315,6 +315,23 @@ class ResourceManager(StateMachine):
             return None
         return machine, instance, inner, spec
 
+    def apply_key(self, operation: Any):
+        """Dependency key for the applying server's parallel-apply
+        classifier (docs/SHARDING.md "Apply ordering"): the catalog
+        RESOURCE an operation mutates — stable resource id, identical on
+        every member (``index * num_groups + group_id`` stamping) — or
+        ``None`` when the footprint is not a single live resource
+        (catalog create/get/delete, unknown instances): the conservative
+        whole-window barrier. Instances of one key share a resource (and
+        its device group), so two instances of the same map collide on
+        the same key — exactly the FIFO the classifier must preserve."""
+        if type(operation) is not InstanceCommand:
+            return None
+        instance = self.instances.get(operation.resource)
+        if instance is None:
+            return None
+        return instance.resource.resource_id
+
     # -- batched read pump (query vector lane) -----------------------------
 
     def query_route(self, operation: Any):

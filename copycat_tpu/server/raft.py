@@ -54,6 +54,7 @@ from .raft_group import (  # noqa: F401 - re-exported compat surface
     RaftGroup,
     _EntryCtx,
     _PeerStream,
+    dispatch_vector_rows,
 )
 from .session import SessionState
 from .state_machine import StateMachine
@@ -119,6 +120,8 @@ class RaftServer(Managed):
             "COPYCAT_INVARIANTS", default="") == "strict"
         self._vector_pump = knobs.get_bool("COPYCAT_SERVER_VECTOR_PUMP")
         self._read_pump = knobs.get_bool("COPYCAT_SERVER_READ_PUMP")
+        self._parallel_apply = knobs.get_bool("COPYCAT_PARALLEL_APPLY")
+        self._apply_fuse = knobs.get_bool("COPYCAT_APPLY_FUSE")
         self._snap_enabled = knobs.get_bool("COPYCAT_SNAPSHOTS")
         self._snap_every = max(1, knobs.get_int("COPYCAT_SNAPSHOT_ENTRIES"))
         self._snap_retain = max(0, knobs.get_int(
@@ -174,6 +177,26 @@ class RaftServer(Managed):
             # class — machines needing arguments must come via a factory
             return type(state_machine)()
 
+        # Cross-group apply fusion (docs/SHARDING.md "Apply ordering"):
+        # groups stage their device-eligible vector runs here instead of
+        # paying one engine round each; the collector dispatches ONCE at
+        # the end of the event-loop turn with mixed groups_idx rows —
+        # one DeviceEngine.run_vector per server turn no matter how many
+        # groups' commits advanced. COPYCAT_APPLY_FUSE=0 keeps the
+        # per-group dispatch (the A/B lane). All groups share one engine
+        # (docs/SHARDING.md), so mixing rows is free; per-group FIFO
+        # holds because runs are staged in per-group log order and the
+        # engine's stable group sort preserves row order within a group.
+        # Initialized BEFORE the groups: boot recovery inside
+        # RaftGroup.__init__ reaches flush_fused via _restore_snapshot.
+        self._fused_runs: list[tuple[RaftGroup, list]] = []
+        self._fuse_scheduled = False
+        self._m_apply_fused = self._metrics.counter("apply.fused_dispatches")
+        self._m_apply_fused_rows = self._metrics.histogram(
+            "apply.fused_rows")
+        self._m_apply_fused_groups = self._metrics.histogram(
+            "apply.fused_groups")
+
         self.groups: list[RaftGroup] = []
         for g in range(groups):
             reg = self._metrics if self.single else MetricsRegistry()
@@ -228,6 +251,12 @@ class RaftServer(Managed):
 
     async def _do_close(self) -> None:
         self._closing = True
+        try:
+            # staged-but-undispatched fused rows complete (and ack)
+            # before the groups fail whatever else is pending
+            self.flush_fused()
+        except Exception:  # noqa: BLE001 — close must proceed
+            logger.exception("fused apply flush at close failed")
         if self.health is not None:
             self.health.stop()
         for grp in self.groups:
@@ -912,6 +941,95 @@ class RaftServer(Managed):
             for (pos, _op), entry in zip(sub, served):
                 entries[pos] = tuple(entry)
         return msg.QueryBatchResponse(index=index, entries=entries)
+
+    # ------------------------------------------------------------------
+    # cross-group apply fusion (docs/SHARDING.md "Apply ordering")
+    # ------------------------------------------------------------------
+
+    def stage_vector_run(self, grp: RaftGroup, run: list) -> None:
+        """Stage one group's vector run for the turn's fused dispatch.
+
+        The dispatch runs at the end of the current event-loop turn
+        (``call_soon``), so every group whose commit advanced this turn
+        contributes rows to ONE engine round; a group that hits a
+        dependency conflict before then forces :meth:`flush_fused`
+        inline (the staged effects must land before the conflicting
+        entry applies)."""
+        self._fused_runs.append((grp, run))
+        if self._fuse_scheduled:
+            return
+        self._fuse_scheduled = True
+        try:
+            asyncio.get_running_loop().call_soon(self._fused_tick)
+        except RuntimeError:
+            # no running loop (synchronous replay harness): dispatch now
+            self.flush_fused()
+
+    def _fused_tick(self) -> None:
+        try:
+            self.flush_fused()
+        except Exception:  # noqa: BLE001 — a loop callback must not raise
+            logger.exception("fused apply dispatch failed")
+
+    def flush_fused(self) -> None:
+        """Dispatch every staged run as ONE mixed-rows engine round,
+        then finalize per group in staging (= per-group log) order.
+        Forced synchronously by dependency conflicts, gated reads,
+        snapshot captures and server close; otherwise runs once per
+        event-loop turn. An empty collector is a free no-op (every
+        forced-flush site relies on that).
+
+        The documented architecture shares ONE engine across groups
+        (``_manager_factory``), so the partition below is normally a
+        single round; an embedder wiring per-group engines still gets
+        correct (per-engine) dispatches instead of corrupted mixed
+        ``groups_idx`` rows."""
+        self._fuse_scheduled = False
+        staged, self._fused_runs = self._fused_runs, []
+        if not staged:
+            return
+        engines: list = []   # insertion-ordered; runs stay in log order
+        per_engine: dict[int, list] = {}
+        for grp, run in staged:
+            engine = grp.state_machine.device_engine
+            bucket = per_engine.get(id(engine))
+            if bucket is None:
+                bucket = per_engine[id(engine)] = []
+                engines.append(engine)
+            bucket.append((grp, run))
+        for engine in engines:
+            self._flush_fused_engine(engine, per_engine[id(engine)])
+
+    def _flush_fused_engine(self, engine, staged: list) -> None:
+        rows = [row for _, run in staged for row in run]
+        self._m_apply_fused.inc()
+        self._m_apply_fused_rows.record(len(rows))
+        self._m_apply_fused_groups.record(
+            len({g.group_id for g, _ in staged}))
+        # mid-batch forced flushes drain the window's in-flight
+        # generator chains from EARLIER entries inside the shared
+        # dispatch helper, so each group's device-op order follows its
+        # log
+        raws, pump_error = dispatch_vector_rows(engine, engine.window,
+                                                rows)
+        offset = 0
+        for grp, run in staged:
+            grp._finalize_vector_run(
+                run,
+                raws[offset:offset + len(run)] if pump_error is None
+                else [], pump_error)
+            offset += len(run)
+
+    def drop_fused(self, grp: RaftGroup) -> None:
+        """Discard ``grp``'s staged rows (group shutdown: its commit
+        futures are failing with NO_LEADER and a restart replays the
+        uncleaned entries from the log)."""
+        if self._fused_runs:
+            self._fused_runs = [(g, r) for g, r in self._fused_runs
+                                if g is not grp]
+        grp._stage_keys.clear()
+        grp._stage_sessions.clear()
+        grp._stage_rows = 0
 
     # ------------------------------------------------------------------
     # observability (docs/OBSERVABILITY.md)

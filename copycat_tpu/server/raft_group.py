@@ -70,6 +70,39 @@ FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 logger = logging.getLogger(__name__)
 
 
+def dispatch_vector_rows(engine: Any, window: Any, rows: list
+                         ) -> tuple[list, str | None]:
+    """ONE engine round for ``rows`` (staged vector-lane tuples, clock
+    first): drain the window's in-flight generator chains so device-op
+    order follows the log, marshal the rows into ``run_vector`` columns,
+    dispatch. Returns ``(raws, pump_error)`` — a barrier or pump failure
+    yields empty ``raws`` with the error set, for the caller's explicit
+    per-entry failure branch (:meth:`RaftGroup._finalize_vector_run`).
+    The ONE marshalling of the staged row shape, shared by the per-group
+    lane (``RaftGroup._apply_vector_run``) and the server's fused
+    cross-group dispatch (``RaftServer._flush_fused_engine``)."""
+    if window is not None and window.busy:
+        try:
+            window.barrier()
+        except Exception as e:  # noqa: BLE001 — fail rows, not hang
+            logger.exception("window drain before vector dispatch failed")
+            return [], str(e)
+    n = len(rows)
+    groups_idx = [0] * n
+    opc = [0] * n
+    av = [0] * n
+    bv = [0] * n
+    cv = [0] * n
+    for k, (_clock, _e, _s, machine, _i, _op, spec) in enumerate(rows):
+        groups_idx[k] = machine._group
+        opc[k], av[k], bv[k], cv[k] = spec[0], spec[1], spec[2], spec[3]
+    try:
+        return engine.run_vector(groups_idx, opc, av, bv, cv), None
+    except Exception as e:  # liveness failure: fail loudly, not hang
+        logger.exception("vector pump failed; failing %d rows", n)
+        return [], str(e)
+
+
 class _EntryCtx:
     """Per-entry execution context for windowed applies.
 
@@ -236,6 +269,14 @@ class RaftGroup:
         self._publish_buffer: list | None = None
         self._window_pending_seqs: set[tuple[int, int]] = set()
         self._advance_scheduled = False  # single-member deferred commit
+        # parallel-apply dependency tracking: resource keys / session
+        # ids with vector rows staged (locally or in the server's fused
+        # collector) whose device effects have not been dispatched yet;
+        # _stage_rows counts them so the contiguous plane (which tracks
+        # no keys) still bounds pending fused rows correctly
+        self._stage_keys: set = set()
+        self._stage_sessions: set[int] = set()
+        self._stage_rows = 0
 
         self._election_timer: Scheduled | None = None
         self._leader_timer: Scheduled | None = None
@@ -262,6 +303,13 @@ class RaftGroup:
         self._m_vector_runs = m.counter("vector_runs")
         self._m_vector_ops = m.counter("vector_ops")
         self._m_run_length = m.histogram("apply_run_length")
+        # Dependency-classified parallel apply (docs/SHARDING.md "Apply
+        # ordering"): committed-window shape, runs spanning ineligible
+        # entries, and conflict-forced flushes. Pre-created so the
+        # family is present (count 0) in every snapshot the CI asserts.
+        self._m_apply_window = m.histogram("apply.window_entries")
+        self._m_apply_spans = m.counter("apply.parallel_spans")
+        self._m_apply_conflicts = m.counter("apply.conflict_flushes")
         self._m_query_windows = m.counter("query_windows")
         self._m_query_ops = m.counter("query_ops")
         self._m_query_window_ops = m.histogram("query_window_ops")
@@ -407,6 +455,14 @@ class RaftGroup:
         return self.server._read_pump
 
     @property
+    def _parallel_apply(self) -> bool:
+        return self.server._parallel_apply
+
+    @property
+    def _apply_fuse(self) -> bool:
+        return self.server._apply_fuse
+
+    @property
     def _snap_enabled(self) -> bool:
         return self.server._snap_enabled
 
@@ -443,6 +499,7 @@ class RaftGroup:
     def shutdown(self) -> None:
         """Cancel timers/streams and fail everything pending (the group
         half of the server's ``_do_close``); the log closes here too."""
+        self.server.drop_fused(self)
         self._cancel_timers()
         self._stop_replication()
         self._trace_clear()
@@ -658,6 +715,10 @@ class RaftGroup:
         if (self._snap_enabled and self._snap_supported
                 and self._snapshots is not None
                 and self.last_applied - self._snap_index >= self._snap_every):
+            # staged-but-undispatched fused vector rows are device
+            # effects the image at last_applied must include — drain
+            # the collector before capturing (a no-op when empty)
+            self.server.flush_fused()
             self._take_snapshot()
 
     def _boot_recover(self) -> None:
@@ -694,6 +755,12 @@ class RaftGroup:
         t0 = time.perf_counter()
         index = payload["index"]
         term = payload["term"]
+        # vector rows parked in the server's fused collector belong to
+        # entries the image (index > last_applied) already covers —
+        # dispatch them against the PRE-restore state they were staged
+        # on, or they would double-apply on top of the restored image
+        # at the end-of-turn tick (a no-op at boot / when empty)
+        self.server.flush_fused()
         # decode EVERYTHING decodable into locals before the first
         # mutation of self, so a malformed image fails fast with this
         # server still pristine (the boot path then falls back to full
@@ -2280,6 +2347,12 @@ class RaftGroup:
                                           timeout=self.election_timeout * 4)
             if not ok:
                 return (msg.INTERNAL, "state lagging behind client index")
+        # ``last_applied`` may cover vector rows parked in the server's
+        # fused collector — the per-op read lanes behind this gate serve
+        # at ``last_applied``, so those device effects must land first
+        # (the read WINDOW flushes in ``run_query_window``; a free no-op
+        # when nothing is staged)
+        self.server.flush_fused()
         return None
 
     async def _on_query(self, request: msg.QueryRequest) -> msg.QueryResponse:
@@ -2483,6 +2556,11 @@ class RaftGroup:
         snapshot. ``check_index`` refuses reads still lagging the
         client's index (a timed-out applied wait) exactly like the
         per-op lane's gate."""
+        # ``last_applied`` may cover vector rows still parked in the
+        # server's fused collector (their device/host effects land at
+        # the turn's one engine round) — reads serve AT last_applied, so
+        # those effects must land first (free no-op when nothing staged)
+        self.server.flush_fused()
         applied = self.last_applied
         clock = self.context.clock
         route = getattr(self.state_machine, "query_route", None)
@@ -2575,7 +2653,16 @@ class RaftGroup:
                 window = begin()  # None on the CPU executor
             if window is not None and self._vector_pump:
                 route = getattr(self.state_machine, "vector_route", None)
-        vrun: list = []  # contiguous run of vector-eligible CommandEntries
+        key_fn = None
+        if route is not None:
+            self._m_apply_window.record(commit_index - self.last_applied)
+            if self._parallel_apply:
+                # dependency-classified windows (docs/SHARDING.md "Apply
+                # ordering"): runs span ineligible entries on disjoint
+                # keys; COPYCAT_PARALLEL_APPLY=0 (or a state machine
+                # without apply_key) keeps the contiguous classifier
+                key_fn = getattr(self.state_machine, "apply_key", None)
+        vrun: list = []  # staged rows: (clock, entry, session, *route rec)
         # Timer deadline for the classify gate, recomputed only after
         # entries that can (un)schedule timers — the per-entry
         # ``next_deadline()`` heap peek was a measured share of the
@@ -2592,26 +2679,51 @@ class RaftGroup:
                 if route is not None and type(entry) is CommandEntry:
                     rec = self._vector_classify(entry, route, deadline)
                     if rec is not None:
-                        vrun.append(rec)
+                        # Advance the log clock AT STAGE TIME: inline
+                        # entries applied while this row waits must see
+                        # the clock the sequential walk would (timer
+                        # gates, commit times); the row carries its own
+                        # clock so finalization stamps the sequential
+                        # per-entry value even after later entries
+                        # advanced the context further.
+                        if entry.timestamp > self.context.clock:
+                            self.context.clock = entry.timestamp
+                        if key_fn is not None:
+                            self._stage_keys.add(key_fn(entry.operation))
+                            self._stage_sessions.add(entry.session_id)
+                        vrun.append((self.context.clock, *rec))
                         continue
                     self._m_vector_refused.inc()
-                if vrun:
-                    # an ineligible entry bounds the run: commit the
-                    # staged tensors first so log order is preserved.
-                    # vrun is emptied BEFORE the call — if the run
-                    # raises (window barrier timeout), replaying it at
-                    # the next flush point would double-apply. Its
-                    # try is SEPARATE from the bounding entry's: a
-                    # failed run must not swallow the entry's apply
-                    # (last_applied already advanced past it; skipping
-                    # it would hang its commit future and, for a config
-                    # entry, diverge this replica's membership view).
-                    run, vrun = vrun, []
-                    try:
-                        self._apply_vector_run(run, window)
-                    except Exception:
-                        logger.exception(
-                            "vector apply failed before index %d", index)
+                if vrun or self._stage_rows:
+                    # An ineligible entry bounds the staged run — always
+                    # on the contiguous plane (key_fn None), only on a
+                    # dependency/session/timer conflict on the parallel
+                    # plane (a disjoint-key entry is spanned; per-key
+                    # FIFO still holds because a colliding entry forces
+                    # the dispatch below BEFORE it applies). vrun is
+                    # emptied BEFORE the call — if the run raises
+                    # (window barrier timeout), replaying it at the next
+                    # flush point would double-apply. Its try is
+                    # SEPARATE from the bounding entry's: a failed run
+                    # must not swallow the entry's apply (last_applied
+                    # already advanced past it; skipping it would hang
+                    # its commit future and, for a config entry, diverge
+                    # this replica's membership view).
+                    if key_fn is None or self._apply_conflicts(
+                            entry, key_fn, deadline):
+                        if key_fn is not None:
+                            self._m_apply_conflicts.inc()
+                        run, vrun = vrun, []
+                        try:
+                            self._bound_vector_run(run, window)
+                        except Exception:
+                            logger.exception(
+                                "vector apply failed before index %d", index)
+                    else:
+                        # spanned: rows are staged locally (vrun) or
+                        # parked in the fused collector (_stage_rows) —
+                        # the outer guard admits no third case
+                        self._m_apply_spans.inc()
                 try:
                     self._apply_entry(entry, window)
                 except Exception:
@@ -2620,7 +2732,7 @@ class RaftGroup:
                     deadline = self.executor.next_deadline()
             if vrun:
                 try:
-                    self._apply_vector_run(vrun, window)
+                    self._stage_vector_tail(vrun, window)
                 except Exception:
                     logger.exception("vector apply failed")
         finally:
@@ -2684,47 +2796,111 @@ class RaftGroup:
             return None
         return (entry, session, *rec)
 
+    def _apply_conflicts(self, entry: Entry, key_fn: Any,
+                         deadline: float | None) -> bool:
+        """Does applying ``entry`` inline conflict with the staged vector
+        rows? The monotone-tag gate of the dependency-classified plane
+        (docs/SHARDING.md "Apply ordering"): a staged run may be spanned
+        by this entry only when the entry provably touches none of the
+        run's resources, sessions, or timers — anything else forces the
+        staged effects to land FIRST, preserving per-key (and
+        per-session) FIFO exactly as the sequential walk would.
+
+        Conflicts, conservatively:
+        - timer adjacency: this entry's tick could fire a state-machine
+          timer (timers touch arbitrary resources);
+        - non-command entries: register/keepalive/unregister/config/noop
+          read or mutate session and membership state broadly (and the
+          takeover ``NoOpEntry`` flush is what keeps the classify-time
+          duplicate-seq argument valid — see ``_vector_classify``);
+        - same session: response cache order, keepalive clocks, and the
+          cached-response dedup all require per-session FIFO;
+        - same or unclassifiable key: ``apply_key`` returns ``None`` for
+          catalog ops (create/get/delete reshape the catalog itself) —
+          the whole-window barrier."""
+        if deadline is not None \
+                and deadline <= max(self.context.clock, entry.timestamp):
+            return True
+        if type(entry) is not CommandEntry:
+            return True
+        if entry.session_id in self._stage_sessions:
+            return True
+        key = key_fn(entry.operation)
+        return key is None or key in self._stage_keys
+
+    def _bound_vector_run(self, run: list, window: Any) -> None:
+        """Dispatch every staged row at a conflict bound: the bounding
+        entry applies only after the staged device effects land. On the
+        fused plane this forces the SERVER's collector synchronously
+        (other groups' staged rows ride along in the same engine round);
+        per-group otherwise."""
+        if self._apply_fuse:
+            if run:
+                self._stage_fused(run)
+            self.server.flush_fused()
+        elif run:
+            self._apply_vector_run(run, window)
+
+    def _stage_vector_tail(self, run: list, window: Any) -> None:
+        """End-of-window dispatch point: on the fused plane the run
+        parks in the server's collector and rides the turn's ONE engine
+        round (``RaftServer.flush_fused``); per-group it dispatches
+        now."""
+        if self._apply_fuse:
+            self._stage_fused(run)
+        else:
+            self._apply_vector_run(run, window)
+
+    def _stage_fused(self, run: list) -> None:
+        """Hand one run to the server's cross-group collector.
+        ``_stage_rows`` counts this group's parked rows so the next
+        ``_apply_up_to`` window still bounds them on conflict (its local
+        ``vrun`` starts empty but the dependency sets persist)."""
+        self._stage_rows += len(run)
+        self.server.stage_vector_run(self, run)
+
     def _apply_vector_run(self, run: list, window: Any) -> None:
-        """Apply one run of vector-eligible commands: ONE vectorized
-        ``submit_batch`` + shared engine rounds for the whole run
-        (``DeviceEngine.run_vector``), then per-entry finalization in log
-        order — response cache, commit futures, held-commit bookkeeping —
-        with zero generator/window machinery per op."""
-        if window.busy:
-            window.barrier()  # drain in-flight chains: log order
-        engine = self.state_machine.device_engine
+        """Apply one run of vector-eligible commands on the PER-GROUP
+        lane (``COPYCAT_APPLY_FUSE=0``): ONE vectorized engine round for
+        the whole run (``DeviceEngine.run_vector``), then per-entry
+        finalization in log order via :meth:`_finalize_vector_run` —
+        with zero generator/window machinery per op. A barrier failure
+        is a pump error (rows fail explicitly, futures resolve) instead
+        of an exception that would silently drop the run."""
+        raws, pump_error = dispatch_vector_rows(
+            self.state_machine.device_engine, window, run)
+        self._finalize_vector_run(run, raws, pump_error)
+
+    def _finalize_vector_run(self, run: list, raws: list,
+                             pump_error: str | None) -> None:
+        """Per-entry finalization of one DISPATCHED run in log order —
+        response cache, commit futures, held-commit bookkeeping — shared
+        by the per-group lane (:meth:`_apply_vector_run`) and the
+        server's fused cross-group dispatch (``RaftServer.flush_fused``).
+
+        A failed pump (``pump_error`` set) takes an EXPLICIT per-entry
+        failure branch: ``raws`` is never indexed (it is empty then —
+        the old guard-path walked ``raws[k]`` behind a short-circuit),
+        every entry's future resolves with the error, and the log slot
+        is cleaned, so a mid-run engine failure degrades to N failed
+        commands instead of N hung futures."""
         n = len(run)
         self._m_vector_runs.inc()
         self._m_vector_ops.inc(n)
         self._m_run_length.record(n)
-        groups = [0] * n
-        opc = [0] * n
-        av = [0] * n
-        bv = [0] * n
-        cv = [0] * n
-        for k, (_e, _s, machine, _i, _op, spec) in enumerate(run):
-            groups[k] = machine._group
-            opc[k], av[k], bv[k], cv[k] = spec[0], spec[1], spec[2], spec[3]
-        pump_error: str | None = None
-        raws: list = []
-        try:
-            raws = engine.run_vector(groups, opc, av, bv, cv)
-        except Exception as e:  # liveness failure: fail loudly, not hang
-            logger.exception("vector pump failed; failing %d entries", n)
-            pump_error = str(e)
-        clock = self.context.clock
         log = self.log
         futures = self._commit_futures
         marks = self._trace_entry_marks
-        for k, (entry, session, machine, instance, inner, spec) in \
+        for k, (clock, entry, session, machine, instance, inner, spec) in \
                 enumerate(run):
             if marks:
                 # vector-lane entries never publish session events, so
                 # the mark is only consumed for leak hygiene here
                 marks.pop(entry.index, None)
-            if entry.timestamp > clock:
-                clock = entry.timestamp
-            if pump_error is None and raws[k] == self._DEVICE_FAIL:
+            if pump_error is not None:
+                result, error = None, pump_error
+                log.clean(entry.index)
+            elif raws[k] == self._DEVICE_FAIL:
                 # the tracked fallback lane can surface the engine's
                 # refusal sentinel (a group emptied by a config change
                 # mid-run); legitimate results never equal it (_devint
@@ -2732,7 +2908,10 @@ class RaftGroup:
                 # would record a refused op as a committed result
                 result, error = None, "device refused the operation"
                 log.clean(entry.index)
-            elif pump_error is None:
+            else:
+                # the row's own staged clock (the sequential per-entry
+                # value), not the context clock — later entries may have
+                # advanced the context past this row's log slot
                 commit = Commit(entry.index, instance.session, clock, inner,
                                 log)
                 try:
@@ -2742,9 +2921,6 @@ class RaftGroup:
                 except Exception as e:  # noqa: BLE001 — app errors cross
                     result, error = None, str(e)
                     log.clean(entry.index)
-            else:
-                result, error = None, pump_error
-                log.clean(entry.index)
             seq = entry.seq
             if seq:
                 session.last_keepalive_time = clock
@@ -2754,8 +2930,20 @@ class RaftGroup:
                 fut.set_result((entry.index, result, error))
             if seq and session.command_futures:
                 self._complete_command(entry, result, error, [])
-        self.context.clock = clock
-        self.executor.tick(clock)  # no deadline <= clock (classify gate)
+        # dependency bookkeeping: this run's rows are no longer staged.
+        # The collector drains whole (never partially), so a zero count
+        # retires the key/session sets; the per-group lane enters with
+        # _stage_rows == 0 and clears them here too.
+        if self._stage_rows > n:
+            self._stage_rows -= n
+        else:
+            self._stage_rows = 0
+            if self._stage_keys:
+                self._stage_keys.clear()
+            if self._stage_sessions:
+                self._stage_sessions.clear()
+        self.executor.tick(self.context.clock)  # fires nothing (classify
+        # gate: every staged row's clock precedes every pending deadline)
 
     def _apply_entry(self, entry: Entry, window: Any = None) -> None:
         self._m_apply_entry.inc()

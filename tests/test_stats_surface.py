@@ -169,8 +169,15 @@ def test_cli_stats_what_all(capsys):
     out = capsys.readouterr().out
     assert "=== stats ===" in out
     assert '"query_windows"' in out
+    # the apply.* family (parallel apply + cross-group fusion, ISSUE 11)
+    # rides both renderings: the group-registry counters in the JSON
+    # snapshot and the server-registry fusion series in the Prometheus
+    # text (names dot->underscore sanitized)
+    assert '"apply.parallel_spans"' in out
+    assert '"apply.fused_dispatches"' in out
     assert "=== metrics ===" in out
     assert "copycat_query_windows" in out
+    assert "copycat_apply_fused_dispatches" in out
     assert "=== flight ===" in out
 
 
@@ -260,6 +267,22 @@ def test_watch_renderer_keeps_labeled_series_distinct():
     keys = [ln.split()[0] for ln in frame.splitlines() if "query" in ln]
     assert keys == ["raft.query_reads{consistency=causal}",
                     "raft.query_windows"]
+
+
+def test_watch_renderer_shows_apply_family_deltas():
+    """`--watch` renders the apply.* family (parallel-apply spans on the
+    group registries, fused-dispatch counters on the server registry)
+    as plain numeric series with deltas — no special casing, but pinned
+    here so the family can't silently fall off the watch surface."""
+    snap = {"node": "n", "raft": {
+        "apply.parallel_spans{group=0}": 4, "apply.fused_dispatches": 7}}
+    prev = cli._flatten_numeric(snap)
+    snap["raft"]["apply.fused_dispatches"] = 10
+    frame = cli._render_watch(snap, prev, 1.0)
+    assert "raft.apply.parallel_spans{group=0}" in frame
+    fused = next(ln for ln in frame.splitlines()
+                 if "apply.fused_dispatches" in ln)
+    assert "+3.0/s" in fused
 
 
 def test_watch_renderer_shows_nested_group_strings():
