@@ -41,32 +41,81 @@ def _server_parser() -> argparse.ArgumentParser:
     parser.add_argument("--log-dir", default=None, metavar="DIR",
                         help="Raft log directory (default: a temp dir, "
                              "removed on exit)")
+    parser.add_argument("--storage", default="disk",
+                        choices=("memory", "mapped", "disk"),
+                        help="log storage level (default disk)")
+    parser.add_argument("--groups", type=int, default=None, metavar="N",
+                        help="Raft groups hosted by this node "
+                             "(docs/SHARDING.md; default COPYCAT_GROUPS)")
+    parser.add_argument("--machine", default=None, metavar="MOD:FACTORY",
+                        help="state-machine factory spec (one machine "
+                             "per group; default: the ResourceManager "
+                             "catalog) — docs/DEPLOYMENT.md")
+    parser.add_argument("--name", default=None, metavar="NAME",
+                        help="node name for logs/stats (default raft)")
     return parser
 
 
+class ConfigError(Exception):
+    """A deployment/config problem the operator (or the supervisor)
+    must fix — exit code 2, never restarted (docs/DEPLOYMENT.md)."""
+
+
+async def _open_with_bind_retry(open_fn, attempts: int = 3,
+                                delay: float = 0.3) -> None:
+    """Open a listener, absorbing TRANSIENT ``EADDRINUSE``: topology
+    specs allocate ports with a release-then-rebind probe
+    (``deploy/topology.py::allocate_ports``), so another bind(0) user
+    can briefly hold our port between the probe and the child's bind.
+    One short retry usually clears it; a port that stays taken after
+    ``attempts`` IS a config error (docs/DEPLOYMENT.md) and propagates
+    so the supervisor stops restarting it."""
+    import errno
+
+    for attempt in range(attempts):
+        try:
+            await open_fn()
+            return
+        except OSError as e:
+            if e.errno != errno.EADDRINUSE or attempt == attempts - 1:
+                raise
+            await asyncio.sleep(delay * (attempt + 1))
+
+
 async def _serve(args: argparse.Namespace) -> None:
+    from .deploy.topology import load_machine
     from .io.tcp import TcpTransport
     from .io.transport import Address
     from .manager.atomix import AtomixServer
     from .server.log import Storage, StorageLevel
 
     members = args.members or ["127.0.0.1:5001"]
-    address = Address.parse(members[0])
-    member_addrs = [Address.parse(a) for a in members]
+    try:
+        address = Address.parse(members[0])
+        member_addrs = [Address.parse(a) for a in members]
+    except (ValueError, TypeError) as e:
+        raise ConfigError(f"bad member address: {e}") from e
 
     # An explicit --log-dir is the operator's to keep; the temp-dir
     # default is ours to remove on exit (it used to leak one
     # copycat-tpu-* dir per run).
     log_dir = args.log_dir or tempfile.mkdtemp(prefix="copycat-tpu-")
     own_log_dir = args.log_dir is None
-    storage = Storage(StorageLevel.DISK, directory=log_dir,
-                      max_entries_per_segment=16)
-    builder = (AtomixServer.builder(address, member_addrs)
-               .with_transport(TcpTransport())
-               .with_storage(storage))
-    if args.stats_port is not None:
-        builder = builder.with_stats_port(args.stats_port, args.stats_host)
-    server = builder.build()
+    level = StorageLevel(getattr(args, "storage", None) or "disk")
+    if level is StorageLevel.MEMORY:
+        storage = Storage(StorageLevel.MEMORY)
+    else:
+        storage = Storage(level, directory=log_dir,
+                          max_entries_per_segment=16)
+    try:
+        machine = load_machine(getattr(args, "machine", None))
+    except (ValueError, ImportError) as e:
+        raise ConfigError(f"--machine: {e}") from e
+    server = AtomixServer(
+        address, member_addrs, TcpTransport(), storage=storage,
+        stats_port=args.stats_port, stats_host=args.stats_host,
+        groups=getattr(args, "groups", None), state_machine=machine,
+        name=getattr(args, "name", None) or "raft")
 
     # Graceful shutdown: SIGINT/SIGTERM close the node (stats listener,
     # transport, log) instead of dying mid-write with the temp dir
@@ -90,7 +139,18 @@ async def _serve(args: argparse.Namespace) -> None:
     try:
         # inside the try: a failed open (port taken, bad stats bind)
         # must still remove the temp log dir below
-        await server.open()
+        try:
+            await _open_with_bind_retry(server.open)
+        except OSError as e:
+            # a bind that cannot succeed no matter how often the node
+            # restarts (port taken, bad stats host) is a CONFIG error:
+            # one line + exit 2, so a supervisor knows to stop
+            # restarting and surface the spec problem instead
+            raise ConfigError(
+                f"cannot start at {address}"
+                + (f" (stats {args.stats_host}:{args.stats_port})"
+                   if args.stats_port is not None else "")
+                + f": {e}") from e
         print(f"server listening at {address} (log: {log_dir})", flush=True)
         if server.stats is not None:
             print(f"stats listener on port {server.stats.port} "
@@ -101,20 +161,36 @@ async def _serve(args: argparse.Namespace) -> None:
     finally:
         try:
             await asyncio.wait_for(server.close(), 10)
-        except (Exception, asyncio.TimeoutError):
-            pass
+        except (Exception, asyncio.TimeoutError) as e:
+            # teardown-only failure: never mask the primary error (the
+            # open/run exception already propagating), but say so in one
+            # line instead of swallowing it invisibly
+            print(f"copycat-server: close failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
         if own_log_dir:
             shutil.rmtree(log_dir, ignore_errors=True)
 
 
 def server(argv: list[str] | None = None) -> None:
-    """``copycat-server host:port [peers...] [--stats-port N]``"""
+    """``copycat-server host:port [peers...] [--stats-port N]``
+
+    Exit codes (what the deployment supervisor keys restart policy
+    off): 0 = clean shutdown, 2 = config error (bad address/machine
+    spec, unbindable port — restarting cannot fix it), 1 = crash. Both
+    failure modes print a ONE-LINE diagnosis instead of a traceback."""
     args = _server_parser().parse_args(
         sys.argv[1:] if argv is None else argv)
     try:
         asyncio.run(_serve(args))
     except KeyboardInterrupt:
         pass
+    except ConfigError as e:
+        print(f"copycat-server: config error: {e}", file=sys.stderr)
+        raise SystemExit(2) from None
+    except Exception as e:  # noqa: BLE001 — a crash, diagnosed in one line
+        print(f"copycat-server: fatal: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        raise SystemExit(1) from None
 
 
 # ---------------------------------------------------------------------------
@@ -473,13 +549,89 @@ def _doctor(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cluster(args: argparse.Namespace) -> int:
+    """``copycat-tpu cluster <action>`` (docs/DEPLOYMENT.md): ``spawn``
+    runs a supervised topology in the foreground — one OS process per
+    member and per ingress proxy, real sockets, real fsync, crash
+    restarts with backoff; ``status`` renders a running supervisor's
+    per-child view from its control listener; ``kill-member`` SIGKILLs
+    one child through the same surface (the supervisor restarts it —
+    the process-level nemesis, operator edition)."""
+    from .server.stats import fetch_stats
+
+    if args.action == "spawn":
+        from .deploy.supervisor import run_foreground
+        from .deploy.topology import TopologySpec, load_machine
+        from .utils import knobs
+
+        try:
+            load_machine(args.machine)  # fail fast: exit 2, not a child loop
+        except (ValueError, ImportError) as e:
+            print(f"copycat-tpu cluster: config error: --machine: {e}",
+                  file=sys.stderr)
+            return 2
+        if args.ingresses and not knobs.get_bool("COPYCAT_INGRESS_TIER"):
+            print("copycat-tpu cluster: COPYCAT_INGRESS_TIER=0 — "
+                  "deploying no ingress processes (in-server ingress "
+                  "path)", flush=True)
+            args.ingresses = 0
+        spec = TopologySpec.local(
+            members=args.members, ingresses=args.ingresses,
+            groups=args.groups, base_dir=args.base_dir,
+            storage=args.storage, machine=args.machine,
+            control_port=args.control_port)
+        return run_foreground(spec)
+
+    rc = _bad_addresses([args.address])
+    if rc:
+        return rc
+
+    def fetch(path: str) -> dict | None:
+        try:
+            return json.loads(asyncio.run(
+                fetch_stats(args.address, path)))
+        except (OSError, RuntimeError, ValueError,
+                asyncio.TimeoutError) as e:
+            print(f"copycat-tpu cluster: cannot reach the supervisor at "
+                  f"{args.address}: {e}\n(is `copycat-tpu cluster spawn` "
+                  f"running, and is this its control port?)",
+                  file=sys.stderr)
+            return None
+
+    if args.action == "status":
+        snap = fetch("/stats")
+        if snap is None:
+            return 1
+        if args.json:
+            print(json.dumps(snap, indent=2, sort_keys=True))
+            return 0
+        print(f"supervisor pid {snap.get('pid')} — control "
+              f"{snap.get('control')}, {snap.get('groups')} group(s)")
+        print(f"clients connect to: "
+              f"{', '.join(snap.get('client_addrs', ()))}")
+        for name, child in snap.get("children", {}).items():
+            up = f"pid {child['pid']}" if child.get("pid") else "down"
+            print(f"  {name:<12} {child['role']:<8} {child['state']:<13} "
+                  f"{up:<10} restarts={child['restarts']} "
+                  f"uptime={child['uptime_s']}s stats={child['stats']}")
+        return 0
+
+    # kill-member: the write verb — /kill/<name> on the control surface
+    out = fetch(f"/kill/{args.name}")
+    if out is None:
+        return 1
+    print(out.get("detail", out))
+    return 0 if out.get("ok") else 1
+
+
 def main(argv: list[str] | None = None) -> None:
     """``copycat-tpu <verb>``: ``stats <host:port>`` reads a running
     server's observability surface; ``trace`` assembles cross-member
     causal waterfalls; ``doctor`` correlates every member's health +
-    black-box + traces into a root-cause report; ``serve`` is
-    ``copycat-server``; ``lint`` runs the copycheck static-analysis
-    suite (jax-free — docs/ANALYSIS.md)."""
+    black-box + traces into a root-cause report; ``cluster`` runs and
+    operates a multi-process deployment (docs/DEPLOYMENT.md);
+    ``serve`` is ``copycat-server``; ``lint`` runs the copycheck
+    static-analysis suite (jax-free — docs/ANALYSIS.md)."""
     raw = sys.argv[1:] if argv is None else argv
     if raw and raw[0] == "lint":
         # copycheck owns its own argparse surface (docs/ANALYSIS.md);
@@ -542,6 +694,50 @@ def main(argv: list[str] | None = None) -> None:
                         help="emit the report as JSON (the CI artifact "
                              "shape) instead of the rendered text")
 
+    cluster = sub.add_parser(
+        "cluster", help="run/operate a multi-process deployment "
+                        "(docs/DEPLOYMENT.md)")
+    csub = cluster.add_subparsers(dest="action", required=True)
+    spawn = csub.add_parser(
+        "spawn", help="launch a supervised topology in the foreground "
+                      "(one OS process per member + ingress proxy)")
+    spawn.add_argument("--members", type=int, default=3, metavar="N",
+                       help="Raft member processes (default 3)")
+    spawn.add_argument("--ingresses", type=int, default=1, metavar="N",
+                       help="standalone ingress/proxy processes fronting "
+                            "the members (default 1; 0 = clients dial "
+                            "members directly)")
+    spawn.add_argument("--groups", type=int, default=1, metavar="G",
+                       help="Raft groups per member (docs/SHARDING.md)")
+    spawn.add_argument("--storage", default="disk",
+                       choices=("memory", "mapped", "disk"),
+                       help="member log storage level (default disk)")
+    spawn.add_argument("--machine", default=None, metavar="MOD:FACTORY",
+                       help="state-machine factory spec for every "
+                            "process (default: the ResourceManager "
+                            "catalog)")
+    spawn.add_argument("--base-dir", default=None, metavar="DIR",
+                       help="log dirs + child stdout logs live here "
+                            "(default: a /tmp topology dir)")
+    spawn.add_argument("--control-port", type=int, default=0,
+                       metavar="PORT",
+                       help="supervisor control listener port "
+                            "(default: ephemeral, printed at boot)")
+    status = csub.add_parser(
+        "status", help="per-child state from a running supervisor")
+    status.add_argument("address", metavar="host:port",
+                        help="the supervisor's control listener")
+    status.add_argument("--json", action="store_true",
+                        help="emit the raw /stats payload")
+    killm = csub.add_parser(
+        "kill-member", help="SIGKILL one child through the control "
+                            "surface (the supervisor restarts it)")
+    killm.add_argument("address", metavar="host:port",
+                       help="the supervisor's control listener")
+    killm.add_argument("name", metavar="NAME",
+                       help="child name (see `cluster status`), e.g. "
+                            "member-1 or ingress-0")
+
     serve = sub.add_parser("serve", help="run a standalone server node")
     serve.add_argument("rest", nargs=argparse.REMAINDER)
 
@@ -559,5 +755,13 @@ def main(argv: list[str] | None = None) -> None:
         raise SystemExit(_trace(args))
     if args.verb == "doctor":
         raise SystemExit(_doctor(args))
+    if args.verb == "cluster":
+        raise SystemExit(_cluster(args))
     if args.verb == "serve":
         server(args.rest)
+
+
+if __name__ == "__main__":
+    # `python -m copycat_tpu.cli ...` == `copycat-tpu ...`: CI and the
+    # deployment supervisor run from a bare checkout, no entry points
+    main()

@@ -331,14 +331,24 @@ class AtomixServer(Managed):
         stats_port: int | None = None,
         stats_host: str = "127.0.0.1",
         groups: int | None = None,
+        state_machine: Any | None = None,
+        name: str = "raft",
     ) -> None:
         super().__init__()
-        machine, groups = _manager_factory(executor, engine_config, groups)
+        if state_machine is None:
+            machine, groups = _manager_factory(executor, engine_config,
+                                               groups)
+        else:
+            # a custom machine (instance or per-group factory) instead
+            # of the ResourceManager catalog — what the deployment
+            # plane's machine-spec children host (docs/DEPLOYMENT.md);
+            # the group count resolves inside RaftServer as usual
+            machine = state_machine
         self.server = RaftServer(
             address, members, transport, machine,
             storage=storage,
             election_timeout=election_timeout, heartbeat_interval=heartbeat_interval,
-            session_timeout=session_timeout, groups=groups)
+            session_timeout=session_timeout, groups=groups, name=name)
         self.address = address
         self._stats_port = stats_port
         self._stats_host = stats_host
@@ -349,7 +359,9 @@ class AtomixServer(Managed):
         return _Builder(AtomixServer, address, members)
 
     async def _do_open(self) -> None:
-        self.server.state_machine.prewarm()
+        prewarm = getattr(self.server.state_machine, "prewarm", None)
+        if callable(prewarm):
+            prewarm()
         await self.server.open()
         if self._stats_port is not None:
             from ..server.stats import StatsListener

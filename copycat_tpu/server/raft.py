@@ -128,6 +128,12 @@ class RaftServer(Managed):
             "COPYCAT_SNAPSHOT_RETAIN",
             default=max(64, self._repl_max_inflight)))
         self._snap_chunk = max(4096, knobs.get_int("COPYCAT_SNAP_CHUNK"))
+        # Standalone ingress/proxy tier (docs/DEPLOYMENT.md): accept
+        # ingress-kind ProxyRequests (and bind proxied sessions for
+        # event relay) on any plane; `0` restores the in-server ingress
+        # path bit-identically (single-group servers then register no
+        # ProxyRequest handler at all).
+        self._ingress_tier = knobs.get_bool("COPYCAT_INGRESS_TIER")
         self._snap_serializer = Serializer()
         self._fsync_on_commit = (
             self.storage.fsync == "commit"
@@ -325,6 +331,14 @@ class RaftServer(Managed):
                 lambda m: g0._on_command_batch(connection, m))
             connection.handler(msg.QueryRequest, g0._on_query)
             connection.handler(msg.QueryBatchRequest, g0._on_query_batch)
+            if self._ingress_tier:
+                # standalone ingress proxies (docs/DEPLOYMENT.md) speak
+                # ProxyRequest to single-group clusters too; with
+                # COPYCAT_INGRESS_TIER=0 the handler is not registered
+                # and the pre-deployment wire surface is bit-identical
+                connection.handler(
+                    msg.ProxyRequest,
+                    lambda m: self._on_proxy(connection, m))
         else:
             connection.handler(
                 msg.RegisterRequest,
@@ -341,7 +355,8 @@ class RaftServer(Managed):
                 lambda m: self._ms_command_batch(connection, m))
             connection.handler(msg.QueryRequest, self._ms_query)
             connection.handler(msg.QueryBatchRequest, self._ms_query_batch)
-            connection.handler(msg.ProxyRequest, self._on_proxy)
+            connection.handler(msg.ProxyRequest,
+                               lambda m: self._on_proxy(connection, m))
         connection.handler(msg.JoinRequest, self._on_join)
         connection.handler(msg.LeaveRequest, self._on_leave)
 
@@ -638,15 +653,59 @@ class RaftServer(Managed):
             await asyncio.sleep(backoff)
             backoff = min(backoff * 2, 0.1)
 
-    async def _on_proxy(self, request: msg.ProxyRequest
-                        ) -> msg.ProxyResponse:
+    async def _on_proxy(self, connection: Connection,
+                        request: msg.ProxyRequest) -> msg.ProxyResponse:
         trace = request.trace
-        response = await self._proxy_local(self._group_of(request),
-                                           request.kind, request.payload,
+        kind = request.kind
+        grp = self._group_of(request)
+        from_ingress = kind.startswith("ingress:")
+        if from_ingress:
+            # a standalone ingress proxy (docs/DEPLOYMENT.md): same
+            # staging entry points, PLUS this member binds the proxied
+            # session to the ingress's connection so event pushes flow
+            # member -> ingress -> client. The prefix is data, not
+            # schema — the wire frames are unchanged.
+            if not self._ingress_tier:
+                return msg.ProxyResponse(
+                    error=msg.INTERNAL,
+                    error_detail="ingress tier disabled on this member "
+                                 "(COPYCAT_INGRESS_TIER=0)")
+            kind = kind[len("ingress:"):]
+        response = await self._proxy_local(grp, kind, request.payload,
                                            trace)
+        if from_ingress and not response.error:
+            self._bind_ingress_session(grp, kind, request.payload,
+                                       response, connection)
         if trace is not None:
             response.trace = trace  # echo: the hop stays correlated
         return response
+
+    def _bind_ingress_session(self, grp: RaftGroup, kind: str,
+                              payload: Any, response: msg.ProxyResponse,
+                              connection: Connection) -> None:
+        """Attach an ingress-proxied session to the ingress's peer
+        connection on THIS group's replica (the ingress holds the real
+        client connection and relays pushes). The binding follows the
+        proxy stream: after a leader change the next proxied
+        keep-alive/command lands here and re-binds on the new leader —
+        events meanwhile queue in the replicated event queue, exactly
+        the reconnect contract direct clients get."""
+        if kind == "register":
+            sid = response.result
+        elif kind in ("keepalive", "commands"):
+            sid = payload[0]
+        elif kind == "unregister":
+            return  # the unregister apply removed the session
+        else:
+            return
+        session = grp.sessions.get(sid)
+        if session is None:
+            return
+        attached = session.connection is not connection
+        session.connection = connection
+        session.last_contact = time.monotonic()
+        if (attached or kind == "keepalive") and session.event_queue:
+            grp._flush_events(session)
 
     async def _proxy_local(self, grp: RaftGroup, kind: str, payload: Any,
                            trace: int | None = None) -> msg.ProxyResponse:

@@ -131,7 +131,12 @@ class StatsListener:
             return self._prometheus().encode(), "text/plain; version=0.0.4"
         if path == "/healthz":
             # minimal liveness: role/term only, no snapshot refresh, no
-            # registry walk — safe to poll at any frequency
+            # registry walk — safe to poll at any frequency (the
+            # deployment supervisor's watch cadence). Non-member hosts
+            # (the standalone ingress tier) provide their own payload.
+            info = getattr(self._raft, "healthz_info", None)
+            if callable(info):
+                return json.dumps(info()).encode(), "application/json"
             g0 = self._raft.groups[0]
             return (json.dumps({
                 "ok": True, "node": str(self._raft.address),

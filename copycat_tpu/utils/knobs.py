@@ -62,6 +62,7 @@ REGISTRY: dict[str, Knob] = {}
 SECTIONS = (
     ("server", "Server planes (vector + read pump)"),
     ("replication", "Replication pipeline"),
+    ("deploy", "Deployment plane (`copycat-tpu cluster`)"),
     ("durability", "Snapshots & durability"),
     ("observability", "Observability & invariants"),
     ("client", "Client"),
@@ -117,6 +118,25 @@ _knob("COPYCAT_REPL_DEPTH", "int", 8,
 _knob("COPYCAT_REPL_MAX_INFLIGHT", "int", None, default_doc="window×depth",
       doc="max entries in flight per peer (slow-follower memory bound)",
       section="replication")
+
+# --- deployment plane ------------------------------------------------------
+_knob("COPYCAT_INGRESS_TIER", "bool", True,
+      "`0` removes the standalone ingress/proxy tier: members refuse "
+      "ingress-kind proxy traffic (single-group servers register no "
+      "ProxyRequest handler), topologies/benches deploy no ingress "
+      "processes — the in-server ingress path bit-identically "
+      "(docs/DEPLOYMENT.md)", section="deploy")
+_knob("COPYCAT_DEPLOY_HEALTH_INTERVAL_S", "float", 1.0,
+      "supervisor `/healthz` poll cadence per child process",
+      section="deploy")
+_knob("COPYCAT_DEPLOY_RESTART_BACKOFF_S", "float", 0.5,
+      "initial restart backoff after a child crash (doubles per "
+      "consecutive crash)", section="deploy")
+_knob("COPYCAT_DEPLOY_RESTART_MAX_S", "float", 8.0,
+      "restart backoff ceiling", section="deploy")
+_knob("COPYCAT_DEPLOY_GRACE_S", "float", 5.0,
+      "seconds between SIGTERM and SIGKILL at teardown",
+      section="deploy")
 
 # --- durability ------------------------------------------------------------
 _knob("COPYCAT_SNAPSHOTS", "bool", True,
@@ -219,7 +239,7 @@ _knob("COPYCAT_VERDICT_DEVICE_TIMEOUT", "float", 120.0,
 _knob("COPYCAT_BENCH_SCENARIO", "str", "counter",
       "scenario: `counter`/`election`/`map`/`map_read`/`lock`/`mixed`/"
       "`host`/`host_read`/`session`/`spi`/`readmix`/`cluster`/`sharded`/"
-      "`apply`/`recovery`",
+      "`apply`/`recovery`/`compartment`",
       section="bench")
 _knob("COPYCAT_BENCH_GROUPS", "int", None,
       default_doc="10000 (election: 1000)",
@@ -372,6 +392,37 @@ _knob("COPYCAT_BENCH_APPLY_INELIGIBLE", "float", 0.25,
       "ops — their log entries interleave with the device sessions' "
       "rows, the shape that collapses the contiguous classifier toward "
       "the per-entry path", section="bench")
+_knob("COPYCAT_BENCH_COMPARTMENT_MEMBERS", "int", 3,
+      "Raft member processes in the compartment scenario",
+      section="bench")
+_knob("COPYCAT_BENCH_COMPARTMENT_TIERS", "str", "1,2,4",
+      "comma-separated ingress-tier widths the compartment scenario "
+      "sweeps (processes per width)", section="bench")
+_knob("COPYCAT_BENCH_COMPARTMENT_GROUPS", "int", 4,
+      "Raft groups in the compartment scenario (`bench.py --groups` "
+      "sets it)", section="bench")
+_knob("COPYCAT_BENCH_COMPARTMENT_CLIENTS", "int", 8,
+      "concurrent TCP clients in the compartment scenario",
+      section="bench")
+_knob("COPYCAT_BENCH_COMPARTMENT_OPS", "int", 600,
+      "commands per client per burst in the compartment scenario",
+      section="bench")
+_knob("COPYCAT_BENCH_COMPARTMENT_BURSTS", "int", 3,
+      "measured bursts (best-of) per tier width", section="bench")
+_knob("COPYCAT_BENCH_COMPARTMENT_KEYS", "int", 1_000_000,
+      "zipfian keyspace size in the compartment scenario (the "
+      "million-key shape)", section="bench")
+_knob("COPYCAT_BENCH_COMPARTMENT_ZIPF", "float", 0.9,
+      "zipf skew exponent for the compartment scenario's key draw",
+      section="bench")
+_knob("COPYCAT_BENCH_COMPARTMENT_STORAGE", "str", "disk",
+      choices=("memory", "mapped", "disk"),
+      doc="member log storage level in the compartment scenario (real "
+          "fsync by default)", section="bench")
+_knob("COPYCAT_BENCH_COMPARTMENT_NEMESIS", "bool", True,
+      "`0` skips the process-level nemesis phase (kill -9 a member + "
+      "an ingress proxy mid-load, zero lost acknowledged writes)",
+      section="bench")
 _knob("COPYCAT_BENCH_NO_CPU_FALLBACK", "bool", False,
       "`1` makes an unreachable accelerator FATAL instead of a degraded "
       "CPU fallback", section="bench")

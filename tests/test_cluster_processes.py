@@ -16,14 +16,17 @@ import pytest
 jax = pytest.importorskip("jax")
 
 from copycat_tpu.atomic import DistributedAtomicLong  # noqa: E402
+from copycat_tpu.deploy.topology import allocate_ports  # noqa: E402
 from copycat_tpu.io.tcp import TcpTransport  # noqa: E402
 from copycat_tpu.io.transport import Address  # noqa: E402
 from copycat_tpu.manager.atomix import AtomixClient  # noqa: E402
 
 from helpers import async_test  # noqa: E402
 
-PORTS = (19361, 19362, 19363)
-ADDRS = [f"127.0.0.1:{p}" for p in PORTS]
+# ephemeral ports via the bind-port-0 probe (deploy.topology): parallel
+# CI runs and leftover listeners can no longer collide the way the old
+# hardcoded 19361-19363 could
+ADDRS = [f"127.0.0.1:{p}" for p in allocate_ports(3)]
 
 
 def _spawn(idx: int, logf):

@@ -1,0 +1,493 @@
+"""Deployment-plane tests (docs/DEPLOYMENT.md): topology specs, the
+supervisor's exit-code contract against real OS processes, and the
+standalone ingress/proxy tier — in-process over the local transport for
+the routing/event/exactly-once seams, and as genuinely killed-and-
+restarted processes for the failover story.
+
+The failover contract under test is PR 1's: a command whose outcome the
+client cannot know (the proxy died holding it) surfaces as INDETERMINATE
+(routing-exhaustion ``NO_LEADER`` / ``TimeoutError``) — never as a
+definite failure, and never applied twice once the client re-routes
+within the ingress tier.
+"""
+
+import asyncio
+import socket
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from copycat_tpu.client.client import (  # noqa: E402
+    PinnedConnectionStrategy,
+    RaftClient,
+)
+from copycat_tpu.deploy.ingress import IngressServer  # noqa: E402
+from copycat_tpu.deploy.supervisor import (  # noqa: E402
+    CONFIG_ERROR,
+    RUNNING,
+    Supervisor,
+)
+from copycat_tpu.deploy.topology import (  # noqa: E402
+    TopologySpec,
+    allocate_ports,
+    load_machine,
+)
+from copycat_tpu.io.local import (  # noqa: E402
+    LocalServerRegistry,
+    LocalTransport,
+)
+from copycat_tpu.io.serializer import serialize_with  # noqa: E402
+from copycat_tpu.io.transport import Address, TransportError  # noqa: E402
+from copycat_tpu.protocol import messages as msg  # noqa: E402
+from copycat_tpu.protocol.messages import Message  # noqa: E402
+from copycat_tpu.protocol.operations import Command  # noqa: E402
+from copycat_tpu.server.raft import LEADER, RaftServer  # noqa: E402
+from copycat_tpu.testing.counter_machine import (  # noqa: E402
+    ClusterAdd,
+    ClusterGet,
+    CounterMachine,
+)
+
+from helpers import async_test  # noqa: E402
+
+MACHINE_SPEC = "copycat_tpu.testing.counter_machine:counter_machine"
+
+
+@serialize_with(951)
+class Poke(Message, Command):
+    """Publishes a session event from the owning group's apply."""
+
+    _fields = ("key", "payload")
+
+
+class PokeCounterMachine(CounterMachine):
+    def configure(self, executor) -> None:
+        super().configure(executor)
+        executor.register(Poke, self.poke)
+
+    def poke(self, commit) -> str:
+        commit.session.publish("poked", commit.operation.payload)
+        commit.clean()
+        return "poked"
+
+
+# ---------------------------------------------------------------------------
+# topology specs (pure units)
+# ---------------------------------------------------------------------------
+
+
+def test_allocate_ports_unique_and_bindable():
+    ports = allocate_ports(20)
+    assert len(set(ports)) == 20
+    # each released port is actually bindable right after the probe
+    s = socket.socket()
+    try:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", ports[0]))
+    finally:
+        s.close()
+
+
+def test_topology_spec_local_shape():
+    spec = TopologySpec.local(members=3, ingresses=2, groups=4,
+                              storage="mapped", machine=MACHINE_SPEC)
+    assert [m.name for m in spec.members] == \
+        ["member-0", "member-1", "member-2"]
+    assert [i.name for i in spec.ingresses] == ["ingress-0", "ingress-1"]
+    # every port in the topology is distinct: raft + stats x every role
+    ports = [m.address.rsplit(":", 1)[1] for m in spec.members]
+    ports += [str(m.stats_port) for m in spec.members]
+    ports += [i.address.rsplit(":", 1)[1] for i in spec.ingresses]
+    ports += [str(i.stats_port) for i in spec.ingresses]
+    assert len(set(ports)) == len(ports)
+    # clients dial the ingress tier when deployed, members otherwise
+    assert spec.client_addrs() == spec.ingress_addrs()
+    bare = TopologySpec.local(members=3, ingresses=0)
+    assert bare.client_addrs() == bare.member_addrs()
+    # each member gets its own log dir under the base
+    dirs = {m.log_dir for m in spec.members}
+    assert len(dirs) == 3
+    assert all(d.startswith(spec.base_dir) for d in dirs)
+    # stats_addrs covers every child by name
+    assert set(spec.stats_addrs()) == {
+        "member-0", "member-1", "member-2", "ingress-0", "ingress-1"}
+    # the /topology control payload round-trips exactly
+    again = TopologySpec.from_json(spec.to_json())
+    assert again.to_json() == spec.to_json()
+
+
+def test_member_and_ingress_argv_shape():
+    spec = TopologySpec.local(members=2, ingresses=1, groups=2,
+                              machine=MACHINE_SPEC)
+    argv = spec.members[0].argv()
+    assert argv[2:4] == ["copycat_tpu.deploy.child", "member"]
+    assert spec.members[0].address in argv
+    # peers exclude self (copycat-server's positional contract)
+    assert argv.count(spec.members[0].address) == 1
+    assert "--machine" in argv
+    iargv = spec.ingresses[0].argv()
+    assert iargv[2:4] == ["copycat_tpu.deploy.child", "ingress"]
+    assert ",".join(spec.member_addrs()) in iargv
+
+
+def test_load_machine_contract():
+    assert load_machine(None) is None
+    assert load_machine("") is None
+    fn = load_machine(MACHINE_SPEC)
+    assert isinstance(fn(0), CounterMachine)
+    with pytest.raises(ValueError, match="expected module.path:factory"):
+        load_machine("no-colon")
+    with pytest.raises(ValueError, match="no attribute"):
+        load_machine("copycat_tpu.testing.counter_machine:missing")
+    with pytest.raises(ImportError):
+        load_machine("copycat_tpu.not_a_module:thing")
+
+
+# ---------------------------------------------------------------------------
+# the standalone ingress tier, in-process (local transport)
+# ---------------------------------------------------------------------------
+
+
+async def _local_cluster(groups: int, machine_cls=CounterMachine,
+                         n: int = 3):
+    registry = LocalServerRegistry()
+    addrs = [Address("local", p) for p in
+             range(18500 + groups * 10, 18500 + groups * 10 + n)]
+    servers = [
+        RaftServer(addr, addrs,
+                   LocalTransport(registry, local_address=addr),
+                   (lambda g: machine_cls()), groups=groups,
+                   election_timeout=0.2, heartbeat_interval=0.04,
+                   session_timeout=30.0)
+        for addr in addrs]
+    await asyncio.gather(*(s.open() for s in servers))
+    deadline = asyncio.get_running_loop().time() + 15
+    while asyncio.get_running_loop().time() < deadline:
+        led = {g.group_id for s in servers for g in s.groups
+               if g.role == LEADER}
+        if len(led) == groups:
+            return registry, servers
+        await asyncio.sleep(0.02)
+    raise TimeoutError("not every group elected a leader")
+
+
+async def _ingress_tier(registry, servers, groups: int, width: int = 1,
+                        machine_cls=CounterMachine, base_port: int = 18900):
+    tier_addrs = [Address("local", base_port + i) for i in range(width)]
+    ingresses = [
+        IngressServer(addr, [s.address for s in servers],
+                      LocalTransport(registry, local_address=addr),
+                      groups=groups, tier=tier_addrs,
+                      route_machine=machine_cls,
+                      session_timeout=30.0, election_timeout=0.2,
+                      name=f"ingress-{i}")
+        for i, addr in enumerate(tier_addrs)]
+    await asyncio.gather(*(i.open() for i in ingresses))
+    return ingresses
+
+
+async def _close_all(*nodes) -> None:
+    for node in nodes:
+        try:
+            await asyncio.wait_for(node.close(), 10)
+        except (Exception, asyncio.TimeoutError):
+            pass
+
+
+@async_test(timeout=120)
+async def test_ingress_routes_commands_and_reads_exactly_once():
+    """Writes and linearizable reads through a standalone ingress land
+    exactly once across 4 groups, and the client is told the INGRESS
+    tier is the cluster (it never learns the members)."""
+    registry, servers = await _local_cluster(groups=4)
+    ingresses = await _ingress_tier(registry, servers, groups=4, width=1)
+    client = RaftClient([ingresses[0].address], LocalTransport(registry),
+                        session_timeout=30.0)
+    try:
+        await client.open()
+        keys = [f"key-{i}" for i in range(24)]
+        for rep in range(2):
+            out = await asyncio.gather(*(
+                client.submit(ClusterAdd(key=k, delta=1)) for k in keys))
+            assert out == [rep + 1] * len(keys), out
+        got = await asyncio.gather(*(client.submit(ClusterGet(key=k))
+                                     for k in keys))
+        assert got == [2] * len(keys), got
+        # the members the client knows are the ingress tier, not the
+        # Raft members behind it
+        assert set(client.members) == {ingresses[0].address}
+        # routing spread across groups actually happened
+        forwarded = ingresses[0].metrics.counter(
+            "ingress.commands_forwarded").value
+        assert forwarded == 2 * len(keys)
+        # every member applied each increment exactly once
+        for s in servers:
+            merged: dict = {}
+            for g in s.groups:
+                merged.update(g.state_machine.data)
+            for k in keys:
+                assert merged.get(k) == 2, (str(s.address), k)
+    finally:
+        await _close_all(client, *ingresses, *servers)
+
+
+@async_test(timeout=120)
+async def test_ingress_relays_session_events():
+    """Events published by the owning group's apply travel member ->
+    ingress (the proxied session binds to the ingress's peer connection)
+    -> the client connection the ingress holds."""
+    registry, servers = await _local_cluster(
+        groups=2, machine_cls=PokeCounterMachine)
+    ingresses = await _ingress_tier(registry, servers, groups=2, width=1,
+                                    machine_cls=PokeCounterMachine)
+    client = RaftClient([ingresses[0].address], LocalTransport(registry),
+                        session_timeout=30.0)
+    try:
+        await client.open()
+        got: list = []
+        client.session().on_event("poked", got.append)
+        # keys owned by BOTH groups: each owning group publishes on its
+        # own channel, both relayed through the one ingress
+        keys = []
+        g_seen = set()
+        i = 0
+        while len(g_seen) < 2:
+            k = f"evt{i}"
+            g = CounterMachine.route_group(ClusterAdd(key=k, delta=0), 2)
+            if g not in g_seen:
+                g_seen.add(g)
+                keys.append(k)
+            i += 1
+        for k in keys:
+            assert await client.submit(Poke(key=k, payload=k)) == "poked"
+        deadline = asyncio.get_running_loop().time() + 10
+        while asyncio.get_running_loop().time() < deadline \
+                and len(got) < 2:
+            await asyncio.sleep(0.02)
+        assert sorted(got) == sorted(keys), got
+        assert ingresses[0].metrics.counter(
+            "ingress.events_relayed").value >= 2
+    finally:
+        await _close_all(client, *ingresses, *servers)
+
+
+@async_test(timeout=180)
+async def test_ingress_failover_midbatch_exactly_once():
+    """Kill the ingress a client is pinned to MID-BATCH: the client
+    re-routes within the tier (it only ever knew the tier) and every
+    submitted command lands at most once — acknowledged ones exactly
+    once, failed ones only as INDETERMINATE (routing exhaustion /
+    timeout), never a definite error, never a double apply."""
+    registry, servers = await _local_cluster(groups=2)
+    ingresses = await _ingress_tier(registry, servers, groups=2, width=2)
+    client = RaftClient([i.address for i in ingresses],
+                        LocalTransport(registry), session_timeout=30.0,
+                        connection_strategy=PinnedConnectionStrategy(
+                            ingresses[0].address))
+    try:
+        await client.open()
+        assert client._connected_to == ingresses[0].address
+        keys = [f"fk{i}" for i in range(120)]
+        futs = {k: client.submit_command_nowait(ClusterAdd(key=k, delta=1))
+                for k in keys}
+        # half the batch is staged/in flight: hard-kill ingress-0 (the
+        # in-process stand-in for the SIGKILL the supervisor test does
+        # with real processes)
+        await asyncio.sleep(0)
+        await ingresses[0].close()
+        acked: dict[str, int] = {}
+        indet: dict[str, int] = {}
+        for k, fut in futs.items():
+            try:
+                await asyncio.wait_for(fut, 30)
+                acked[k] = 1
+            except asyncio.TimeoutError:
+                indet[k] = 1
+            except msg.ProtocolError as e:
+                # the PR 1 contract: only the in-doubt codes may surface
+                assert e.code in (msg.NO_LEADER, msg.NOT_LEADER), e.code
+                indet[k] = 1
+        # the client re-routed WITHIN the tier
+        follow_up = await client.submit(ClusterAdd(key="after", delta=1))
+        assert follow_up == 1
+        assert client._connected_to == ingresses[1].address
+        # exactly-once: every acked write present, in-doubt ones at most
+        # once — read through the surviving ingress
+        for k in keys:
+            v = await client.submit(ClusterGet(key=k))
+            lo = acked.get(k, 0)
+            hi = lo + indet.get(k, 0)
+            assert lo <= v <= hi, (k, v, lo, hi)
+        assert acked, "kill window swallowed the whole batch"
+    finally:
+        await _close_all(client, *ingresses, *servers)
+
+
+# ---------------------------------------------------------------------------
+# COPYCAT_INGRESS_TIER=0: the in-server ingress plane, pinned
+# ---------------------------------------------------------------------------
+
+
+@async_test(timeout=60)
+async def test_ingress_tier_knob_off_single_group_has_no_proxy_handler(
+        monkeypatch):
+    """With the knob off, a single-group server registers NO
+    ProxyRequest handler at all — the wire surface is the pre-deployment
+    plane bit-identically, not a live-but-refusing route."""
+    monkeypatch.setenv("COPYCAT_INGRESS_TIER", "0")
+    registry, servers = await _local_cluster(groups=1)
+    transport = LocalTransport(registry)
+    try:
+        conn = await transport.client().connect(servers[0].address)
+        with pytest.raises(TransportError, match="no handler"):
+            await conn.send(msg.ProxyRequest(
+                group=None, kind="ingress:register",
+                payload=("cid", 5.0, None)))
+    finally:
+        await _close_all(*servers)
+
+
+@async_test(timeout=60)
+async def test_ingress_tier_knob_off_multi_group_refuses(monkeypatch):
+    """Multi-group servers keep their member->member proxy plane with
+    the knob off, but refuse INGRESS-kind traffic explicitly."""
+    monkeypatch.setenv("COPYCAT_INGRESS_TIER", "0")
+    registry, servers = await _local_cluster(groups=2)
+    transport = LocalTransport(registry)
+    try:
+        conn = await transport.client().connect(servers[0].address)
+        response = await conn.send(msg.ProxyRequest(
+            group=0, kind="ingress:register", payload=("cid", 5.0, None)))
+        assert response.error == msg.INTERNAL
+        assert "ingress tier disabled" in response.error_detail
+    finally:
+        await _close_all(*servers)
+
+
+@async_test(timeout=60)
+async def test_ingress_tier_knob_off_in_server_path_unchanged(monkeypatch):
+    """The A/B differential: with the knob off, the classic client ->
+    member ingress works exactly as before (same results, same
+    exactly-once), because the knob only gates the NEW acceptance."""
+    monkeypatch.setenv("COPYCAT_INGRESS_TIER", "0")
+    registry, servers = await _local_cluster(groups=2)
+    client = RaftClient([s.address for s in servers],
+                        LocalTransport(registry), session_timeout=30.0)
+    try:
+        await client.open()
+        for rep in range(2):
+            out = await asyncio.gather(*(
+                client.submit(ClusterAdd(key=f"d{i}", delta=1))
+                for i in range(8)))
+            assert out == [rep + 1] * 8, out
+    finally:
+        await _close_all(client, *servers)
+
+
+# ---------------------------------------------------------------------------
+# the supervisor against real OS processes
+# ---------------------------------------------------------------------------
+
+
+@async_test(timeout=600)
+async def test_supervisor_restarts_sigkilled_children_and_clients_survive():
+    """The process-level nemesis, test edition: SIGKILL the ingress
+    proxy a client is pinned to AND a Raft member mid-run; the client
+    re-routes within the tier with zero lost acknowledged writes and
+    the supervisor restarts both corpses with backoff."""
+    from copycat_tpu.io.tcp import TcpTransport
+
+    # disk storage is load-bearing: this test SIGKILLs member-1, and a
+    # MEMORY member restarts blank (log + voted_for gone) — it could
+    # then grant a vote electing a leader missing an acked entry, a
+    # true lost acknowledged write the zero-lost assertion would catch
+    spec = TopologySpec.local(members=3, ingresses=2, groups=1,
+                              storage="disk", machine=MACHINE_SPEC)
+    sup = Supervisor(spec)
+    await sup.open()
+    client = None
+    try:
+        await sup.wait_healthy(timeout=240)
+        addrs = [Address.parse(a) for a in spec.client_addrs()]
+        client = RaftClient(addrs, TcpTransport(), session_timeout=60.0,
+                            connection_strategy=PinnedConnectionStrategy(
+                                addrs[0]))
+        await client.open()
+        acked = 0
+        for _ in range(5):
+            await client.submit(ClusterAdd(key="n", delta=1))
+            acked += 1
+
+        # SIGKILL the proxy holding this client mid-batch
+        futs = [client.submit_command_nowait(ClusterAdd(key="n", delta=1))
+                for _ in range(40)]
+        await asyncio.sleep(0)
+        ok, detail = sup.kill("ingress-0")
+        assert ok, detail
+        indet = 0
+        for fut in futs:
+            try:
+                await asyncio.wait_for(fut, 60)
+                acked += 1
+            except asyncio.TimeoutError:
+                indet += 1
+            except msg.ProtocolError as e:
+                assert e.code in (msg.NO_LEADER, msg.NOT_LEADER), e.code
+                indet += 1
+
+        # and a member too (quorum survives)
+        ok, detail = sup.kill("member-1")
+        assert ok, detail
+        await client.submit(ClusterAdd(key="n", delta=1))
+        acked += 1
+
+        # zero lost acknowledged writes, at-most-once for in-doubt ones
+        v = await client.submit(ClusterGet(key="n"))
+        assert acked <= v <= acked + indet, (v, acked, indet)
+
+        # both corpses come back under supervision
+        deadline = asyncio.get_running_loop().time() + 90
+        while asyncio.get_running_loop().time() < deadline:
+            children = sup.status()["children"]
+            if all(children[n]["state"] == RUNNING and children[n]["pid"]
+                   and children[n]["restarts"] >= 1
+                   for n in ("ingress-0", "member-1")):
+                break
+            await asyncio.sleep(0.25)
+        children = sup.status()["children"]
+        for name in ("ingress-0", "member-1"):
+            assert children[name]["state"] == RUNNING, children[name]
+            assert children[name]["restarts"] >= 1, children[name]
+    finally:
+        if client is not None:
+            await _close_all(client)
+        await sup.close()
+
+
+@async_test(timeout=300)
+async def test_supervisor_config_error_is_terminal():
+    """Exit code 2 (a port that can never bind) is a CONFIG error: the
+    supervisor surfaces it and never crash-loops the child."""
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    spec = TopologySpec.local(members=1, ingresses=0, storage="memory",
+                              machine=MACHINE_SPEC)
+    spec.members[0].address = f"127.0.0.1:{port}"
+    spec.members[0].peers = [f"127.0.0.1:{port}"]
+    sup = Supervisor(spec)
+    await sup.open()
+    try:
+        child = sup._children["member-0"]
+        deadline = asyncio.get_running_loop().time() + 240
+        while child.state != CONFIG_ERROR:
+            assert asyncio.get_running_loop().time() < deadline, child.state
+            await asyncio.sleep(0.2)
+        assert child.last_exit == 2
+        assert child.restarts == 0
+        assert sup.healthz_info()["ok"] is False
+    finally:
+        await sup.close()
+        blocker.close()
