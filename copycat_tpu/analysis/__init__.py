@@ -1,16 +1,28 @@
 """copycheck — project-native static analysis (docs/ANALYSIS.md).
 
-Seven AST-based rules, each grounded in a hazard this codebase has
+Ten AST-based rules over a package-wide async call graph
+(:mod:`callgraph`), each grounded in a hazard this codebase has
 actually hit (flight-recorder findings, the PR 6 torn-write post-mortem,
-the ``utils/tasks.py`` weakref note):
+the ``utils/tasks.py`` weakref note, the PR 12 exit-code contract):
 
 - ``loop-blocking`` — event-loop-blocking calls inside ``async def``
-  bodies (latency hazards for the repl/read pumps);
+  bodies AND inside sync helpers the call graph proves reachable from
+  one (latency hazards for the repl/read pumps);
 - ``orphan-task`` — raw ``create_task``/``ensure_future`` outside
   ``utils/tasks.spawn`` (the fire-and-forget weakref-GC hazard);
-- ``await-tear`` — an ``await`` between a read and an unguarded write of
-  protected Raft state in ``server/raft.py`` (the asyncio analogue of a
-  race detector);
+- ``await-tear`` — an unguarded protected-state write after a
+  suspension point across the server+deploy plane, interprocedurally:
+  awaits of never-suspending helpers don't count, ``async with``/
+  ``async for`` and writes hidden in called helpers do (the asyncio
+  analogue of a race detector);
+- ``durability-order`` — inside ``RaftGroup``, no commit/command future
+  resolve or success append ack unless dominated by the commit-boundary
+  ``_sync_log`` (the "fsync before ack" guarantee, statically);
+- ``span-pairing`` — span-record call sites use vocabulary names from
+  ``docs/OBSERVABILITY.md``, never ``with`` over the completed-span
+  API, never an unentered ``.timer(...)``;
+- ``exit-code`` — deploy-plane mains exit only with the documented
+  0/1/2 contract the supervisor's restart policy keys off;
 - ``knob-registry`` — every ``COPYCAT_*`` env read goes through
   ``utils/knobs.py``; every knob named is registered;
 - ``metric-registry`` — every metric call site uses a name from the
@@ -21,10 +33,11 @@ the ``utils/tasks.py`` weakref note):
   reachable inside the jitted ``ops/`` step functions.
 
 Run with ``copycat-tpu lint`` (or ``python -m copycat_tpu.analysis``);
-``--strict`` is the CI gate. Findings are suppressed inline with
-``# copycheck: ignore[rule]`` or carried (with a justification) in
-``.copycheck-baseline.json``. Pure stdlib + AST: linting never imports
-jax or the modules it checks.
+``--strict`` is the CI gate, ``--format sarif`` the code-scanning
+emitter, ``--changed BASE`` the diff mode. Findings are suppressed
+inline with ``# copycheck: ignore[rule]`` or carried (with a
+justification) in ``.copycheck-baseline.json``. Pure stdlib + AST:
+linting never imports jax or the modules it checks.
 """
 
 from .engine import LintContext, run_lint  # noqa: F401
@@ -34,6 +47,9 @@ ALL_RULES = (
     "loop-blocking",
     "orphan-task",
     "await-tear",
+    "durability-order",
+    "span-pairing",
+    "exit-code",
     "knob-registry",
     "metric-registry",
     "wire-schema",

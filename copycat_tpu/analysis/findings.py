@@ -31,15 +31,26 @@ class Finding:
     line: int
     message: str
     symbol: str = "<module>"
+    #: optional call-chain metadata for interprocedural findings (the
+    #: labels from the async root to the flagged site). Deliberately
+    #: NOT part of identity(): the example chain may reroute under
+    #: unrelated edits, and a baselined finding must not resurrect.
+    via: list | None = None
 
     def identity(self) -> tuple[str, str, str, str]:
         return (self.rule, self.path, self.symbol, self.message)
 
     def render(self) -> str:
-        return f"{self.path}:{self.line}: {self.rule}: {self.message} [{self.symbol}]"
+        out = f"{self.path}:{self.line}: {self.rule}: {self.message} [{self.symbol}]"
+        if self.via:
+            out += "\n    via " + " -> ".join(self.via)
+        return out
 
     def to_json(self) -> dict:
-        return asdict(self)
+        d = asdict(self)
+        if d.get("via") is None:
+            del d["via"]
+        return d
 
 
 def scan_suppressions(source: str) -> dict[int, set[str]]:

@@ -4,8 +4,15 @@
 loop when made from an ``async def`` body. One stalled turn holds every
 staged read window and replication ack behind it — the latency hazard
 is measured, not theoretical (the read pump coalesces per event-loop
-turn, PERF.md round 9). Nested *sync* defs are skipped: blocking there
-is judged at the call site.
+turn, PERF.md round 9). Since copycheck v2 the rule is
+**interprocedural**: a blocking call inside a SYNC helper is flagged
+when the package call graph (:mod:`callgraph`) proves the helper
+reachable from an ``async def`` through resolved sync calls — running a
+sync helper inline IS running its blocking call on the loop thread. The
+finding lands on the blocking call and carries the call chain from the
+async root in its message (and ``via`` metadata). Nested sync defs
+inside an async def are still skipped lexically: they are judged where
+they're reachable from, not where they're written.
 
 ``orphan-task``: ``loop.create_task`` / ``asyncio.ensure_future``
 anywhere but ``utils/tasks.py``. The loop holds only a weak reference to
@@ -25,6 +32,7 @@ from .astutil import (
     enclosing_symbol,
     iter_async_functions,
 )
+from .callgraph import CallGraph, awaited_call_nodes, own_body
 from .findings import Finding
 
 # Call chains that block the calling thread. Receiver-qualified names
@@ -36,13 +44,17 @@ BLOCKING_CALLS = {
     "os.fsync": "synchronous disk flush on the loop thread",
     "os.fdatasync": "synchronous disk flush on the loop thread",
     "os.replace": "synchronous rename on the loop thread",
+    "os.waitpid": "blocking child-process wait on the loop thread",
     "subprocess.run": "blocking subprocess wait",
     "subprocess.call": "blocking subprocess wait",
     "subprocess.check_call": "blocking subprocess wait",
     "subprocess.check_output": "blocking subprocess wait",
+    "socket.create_connection": "blocking connect on the loop thread; "
+                                "use the loop/transport APIs",
     "shutil.rmtree": "synchronous recursive delete on the loop thread",
     "shutil.copyfile": "synchronous file copy on the loop thread",
     "shutil.copytree": "synchronous tree copy on the loop thread",
+    "shutil.copyfileobj": "synchronous stream copy on the loop thread",
     "jax.device_get": "synchronous device fetch on the loop thread",
     "jax.block_until_ready": "synchronous device sync on the loop thread",
 }
@@ -50,6 +62,14 @@ BLOCKING_CALLS = {
 # Method names that block regardless of receiver.
 BLOCKING_METHODS = {
     "block_until_ready": "synchronous device sync on the loop thread",
+}
+
+# Method names that block UNLESS the call sits under an ``await``
+# (``proc.wait()`` from subprocess.Popen blocks; ``await proc.wait()``
+# and ``await wait_for(proc.wait(), t)`` are the asyncio coroutine).
+BLOCKING_METHODS_UNLESS_AWAITED = {
+    "wait": "blocking wait (Popen.wait / Event.wait) on the loop thread; "
+            "await the asyncio form instead",
 }
 
 # The builtin ``open``: sync file I/O from a coroutine.
@@ -60,29 +80,72 @@ BLOCKING_BUILTINS = {
 SPAWN_CALLS = ("create_task", "ensure_future")
 
 
-def check_loop_blocking(tree: ast.Module, path: str) -> list[Finding]:
+def _blocking_reason(node: ast.Call,
+                     awaited: set[int] | None = None) -> tuple | None:
+    """``(culprit, why)`` when this call matches the blocklist."""
+    name = dotted_name(node.func)
+    if name in BLOCKING_CALLS:
+        return f"`{name}(...)`", BLOCKING_CALLS[name]
+    if isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        if attr in BLOCKING_METHODS:
+            return f"`.{attr}(...)`", BLOCKING_METHODS[attr]
+        if attr in BLOCKING_METHODS_UNLESS_AWAITED \
+                and not (awaited and id(node) in awaited):
+            return (f"`.{attr}(...)`",
+                    BLOCKING_METHODS_UNLESS_AWAITED[attr])
+    if isinstance(node.func, ast.Name) and node.func.id in BLOCKING_BUILTINS:
+        return f"`{node.func.id}(...)`", BLOCKING_BUILTINS[node.func.id]
+    return None
+
+
+def check_loop_blocking(tree: ast.Module, path: str,
+                        graph: CallGraph | None = None) -> list[Finding]:
     findings: list[Finding] = []
     for fn, qual in iter_async_functions(tree):
+        awaited = awaited_call_nodes(fn)
         for node in body_nodes_excluding_nested_defs(fn):
             if not isinstance(node, ast.Call):
                 continue
-            why = None
-            name = dotted_name(node.func)
-            if name in BLOCKING_CALLS:
-                why = f"`{name}(...)` — {BLOCKING_CALLS[name]}"
-            elif (isinstance(node.func, ast.Attribute)
-                  and node.func.attr in BLOCKING_METHODS):
-                why = (f"`.{node.func.attr}(...)` — "
-                       f"{BLOCKING_METHODS[node.func.attr]}")
-            elif (isinstance(node.func, ast.Name)
-                  and node.func.id in BLOCKING_BUILTINS):
-                why = (f"`{node.func.id}(...)` — "
-                       f"{BLOCKING_BUILTINS[node.func.id]}")
-            if why:
+            hit = _blocking_reason(node, awaited)
+            if hit:
+                culprit, why = hit
                 findings.append(Finding(
                     rule="loop-blocking", path=path, line=node.lineno,
-                    message=f"blocking call in async def: {why}",
+                    message=f"blocking call in async def: {culprit} — {why}",
                     symbol=qual))
+    if graph is not None:
+        findings += _check_reachable_blocking(tree, path, graph)
+    return findings
+
+
+def _check_reachable_blocking(tree: ast.Module, path: str,
+                              graph: CallGraph) -> list[Finding]:
+    """Interprocedural half: blocking calls inside SYNC functions of
+    this file that the graph proves reachable from an async def."""
+    findings: list[Finding] = []
+    for (fpath, qual), chain in sorted(graph.async_reachable.items()):
+        if fpath != path:
+            continue
+        info = graph.info_for(fpath, qual)
+        if info is None or info.node is None:
+            continue
+        awaited = awaited_call_nodes(info.node)
+        for node in own_body(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = _blocking_reason(node, awaited)
+            if hit:
+                culprit, why = hit
+                # the example chain rides `via` metadata, NOT the
+                # message: finding identity (baseline matching) must not
+                # churn when an unrelated edit reroutes the shortest
+                # discovered path
+                findings.append(Finding(
+                    rule="loop-blocking", path=path, line=node.lineno,
+                    message=(f"blocking call in a sync helper reachable "
+                             f"from an async def: {culprit} — {why}"),
+                    symbol=qual, via=list(chain)))
     return findings
 
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 import ast
 
 from .astutil import dotted_name
+from .callgraph import callee_names, local_functions
 from .findings import Finding
 
 FORBIDDEN_CALLS = {
@@ -95,30 +96,16 @@ def collect_jit_roots(trees: dict[str, ast.Module]) -> set[str]:
     return roots
 
 
-def _local_functions(tree: ast.Module) -> dict[str, ast.AST]:
-    return {node.name: node for node in tree.body
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))}
-
-
-def _callees(fn: ast.AST) -> set[str]:
-    out: set[str] = set()
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call):
-            if isinstance(node.func, ast.Name):
-                out.add(node.func.id)
-            elif isinstance(node.func, ast.Attribute):
-                out.add(node.func.attr)
-        elif isinstance(node, ast.Name):
-            # functions passed as values (e.g. lax.scan(body, ...))
-            out.add(node.id)
-    return out
+# The name-level resolution machinery this rule pioneered now lives in
+# callgraph.py (local_functions / callee_names), where the package-wide
+# async call graph builds on the same over-approximation.
 
 
 def check_jit_purity(tree: ast.Module, path: str,
                      jit_roots: set[str]) -> list[Finding]:
     if "/ops/" not in f"/{path}":
         return []
-    local = _local_functions(tree)
+    local = local_functions(tree)
     reachable: set[str] = set()
     frontier = [name for name in local if name in jit_roots]
     while frontier:
@@ -126,7 +113,7 @@ def check_jit_purity(tree: ast.Module, path: str,
         if name in reachable:
             continue
         reachable.add(name)
-        frontier.extend(c for c in _callees(local[name])
+        frontier.extend(c for c in callee_names(local[name])
                         if c in local and c not in reachable)
     findings: list[Finding] = []
     for name in sorted(reachable):

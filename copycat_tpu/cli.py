@@ -745,7 +745,10 @@ def main(argv: list[str] | None = None) -> None:
     # argparse so copycheck's own flags (--strict, --format...) pass
     # through untouched
     sub.add_parser("lint", help="run the copycheck static-analysis "
-                                "suite (docs/ANALYSIS.md)",
+                                "suite (docs/ANALYSIS.md; --strict is "
+                                "the CI gate, --format sarif the "
+                                "code-scanning emitter, --changed BASE "
+                                "the diff mode)",
                    add_help=False)
 
     args = parser.parse_args(raw)

@@ -31,7 +31,15 @@ from copycat_tpu.analysis.rules_asyncio import (
     check_loop_blocking,
     check_orphan_task,
 )
+from copycat_tpu.analysis.callgraph import CallGraph
 from copycat_tpu.analysis.rules_await_tear import check_await_tear
+from copycat_tpu.analysis.rules_contracts import (
+    check_durability_order,
+    check_exit_contract,
+    check_span_contract,
+    parse_exit_codes,
+    parse_span_catalog,
+)
 from copycat_tpu.analysis.rules_jit import check_jit_purity, collect_jit_roots
 from copycat_tpu.analysis.rules_registries import (
     check_knob_registry,
@@ -96,6 +104,100 @@ def test_loop_blocking_allows_asyncio_sleep():
             await asyncio.sleep(0.1)
     """)
     assert check_loop_blocking(tree, "pkg/mod.py") == []
+
+
+def _graph(path: str, code: str) -> tuple[ast.Module, CallGraph]:
+    tree = _tree(code)
+    return tree, CallGraph.build({path: tree})
+
+
+def test_loop_blocking_interprocedural_reaches_into_sync_helpers():
+    # the v2 tentpole: the blocking call sits in a SYNC helper — lexically
+    # invisible to the v1 rule — and is flagged because the call graph
+    # proves the helper reachable from an async def
+    tree, graph = _graph("pkg/mod.py", """
+        import subprocess
+
+        def run_tool(cmd):
+            return subprocess.run(cmd)
+
+        async def pump(cmd):
+            return run_tool(cmd)
+    """)
+    assert check_loop_blocking(tree, "pkg/mod.py") == []  # lexical-only: blind
+    found = check_loop_blocking(tree, "pkg/mod.py", graph)
+    assert len(found) == 1
+    assert found[0].symbol == "run_tool"
+    assert "reachable from an async def" in found[0].message
+    assert found[0].via == ["pkg/mod.py::pump", "pkg/mod.py::run_tool"]
+    # ...and the chain closes transitively through sync middlemen
+    tree2, graph2 = _graph("pkg/mod.py", """
+        import subprocess
+
+        def inner(cmd):
+            return subprocess.run(cmd)
+
+        def outer(cmd):
+            return inner(cmd)
+
+        async def pump(cmd):
+            return outer(cmd)
+    """)
+    found = check_loop_blocking(tree2, "pkg/mod.py", graph2)
+    assert len(found) == 1 and found[0].symbol == "inner"
+    assert found[0].via[-1] == "pkg/mod.py::inner"
+
+
+def test_loop_blocking_spares_helpers_no_async_def_reaches():
+    tree, graph = _graph("pkg/mod.py", """
+        import subprocess
+
+        def run_tool(cmd):
+            return subprocess.run(cmd)
+
+        def sync_caller(cmd):
+            return run_tool(cmd)
+    """)
+    assert check_loop_blocking(tree, "pkg/mod.py", graph) == []
+
+
+def test_loop_blocking_deploy_plane_blocklist_entries():
+    # the post-PR 7 hazards: child-process waits, blocking connects,
+    # sync stream copies (the deploy plane's bread and butter)
+    tree = _tree("""
+        import os, socket, shutil, subprocess
+
+        async def bad(a, b, proc):
+            os.waitpid(1, 0)
+            socket.create_connection(("host", 1))
+            shutil.copyfileobj(a, b)
+            subprocess.check_output(["x"])
+            proc.wait()
+    """)
+    found = check_loop_blocking(tree, "pkg/mod.py")
+    assert len(found) == 5
+
+
+def test_loop_blocking_awaited_wait_is_the_asyncio_form():
+    # `proc.wait()` blocks (Popen.wait); `await proc.wait()` is the
+    # asyncio.subprocess coroutine — only the bare call is a finding
+    tree = _tree("""
+        import asyncio
+
+        async def fine(proc, cond):
+            await proc.wait()
+            await asyncio.wait_for(cond.wait(), 1.0)
+
+        async def bad(proc):
+            proc.wait()
+    """)
+    found = check_loop_blocking(tree, "pkg/mod.py")
+    assert len(found) == 1 and found[0].symbol == "bad"
+
+
+def test_loop_blocking_live_tree_is_clean():
+    result = run_lint(root=REPO, use_cache=False)
+    assert [f for f in result.findings if f.rule == "loop-blocking"] == []
 
 
 # ---------------------------------------------------------------------------
@@ -270,6 +372,439 @@ def test_await_tear_ignores_pre_await_writes_and_other_files():
 def test_await_tear_live_tree_is_clean():
     result = run_lint(root=REPO, use_cache=False)
     assert [f for f in result.findings if f.rule == "await-tear"] == []
+
+
+# --- interprocedural (copycheck v2): the call graph closes the two
+# lexical blind spots — writes hidden in called helpers, and suspension
+# classification in both directions -----------------------------------------
+
+HIDDEN_WRITE = """
+    class RaftGroup:
+        def _commit_term(self, t):
+            self.term = t
+
+        async def transition(self, peer):
+            term = self.term
+            response = await self.send(peer, term)
+            self._commit_term(response.term)
+"""
+
+
+def test_await_tear_interprocedural_flags_write_hidden_in_helper():
+    # the fixture the lexical rule PROVABLY missed: no attribute store
+    # is lexically visible after the await — the torn write hides inside
+    # the called helper, surfaced by the effect summary
+    tree = _tree(HIDDEN_WRITE)
+    assert check_await_tear(tree, "server/raft_group.py") == []  # v1 view
+    graph = CallGraph.build({"server/raft_group.py": tree})
+    found = check_await_tear(tree, "server/raft_group.py", graph)
+    assert len(found) == 1
+    assert "write hidden in" in found[0].message
+    assert "self.term" in found[0].message
+    assert found[0].via == ["server/raft_group.py::RaftGroup._commit_term"]
+
+
+def test_await_tear_interprocedural_guard_still_discharges_hidden_write():
+    tree = _tree("""
+        class RaftGroup:
+            def _commit_term(self, t):
+                self.term = t
+
+            async def transition(self, peer):
+                term = self.term
+                response = await self.send(peer, term)
+                if self.term != term:
+                    return
+                self._commit_term(response.term)
+    """)
+    graph = CallGraph.build({"server/raft_group.py": tree})
+    assert check_await_tear(tree, "server/raft_group.py", graph) == []
+
+
+def test_await_tear_never_suspending_await_is_not_an_interleaving_point():
+    # precision the lexical rule lacked the OTHER way: an await of a
+    # local coroutine with no yield point of its own cannot interleave
+    tree = _tree("""
+        class RaftGroup:
+            async def _bump(self, x):
+                return x + 1
+
+            async def transition(self):
+                term = self.term
+                term = await self._bump(term)
+                self.term = term
+    """)
+    assert len(check_await_tear(tree, "server/raft.py")) == 1  # v1: flagged
+    graph = CallGraph.build({"server/raft.py": tree})
+    assert check_await_tear(tree, "server/raft.py", graph) == []
+
+
+def test_await_tear_async_with_is_a_suspension_point():
+    # `async with` acquires on entry — a yield point with no Await node,
+    # invisible to the lexical rule
+    tree = _tree("""
+        class RaftGroup:
+            async def transition(self):
+                term = self.term
+                async with self.gate:
+                    self.term = term + 1
+    """)
+    graph = CallGraph.build({"server/raft.py": tree})
+    found = check_await_tear(tree, "server/raft.py", graph)
+    assert len(found) == 1 and "self.term" in found[0].message
+
+
+def test_await_tear_summary_cache_never_keeps_truncated_entries():
+    # regression: summarizing `_a` walks _b/_c/_d at depths 1-3 and the
+    # depth cap truncates `_w`'s write out of `_d`'s summary — that
+    # truncated view must NOT be cached, or the later direct
+    # `self._d()` call site (a fresh depth-0 query) misses a real tear
+    tree = _tree("""
+        class RaftGroup:
+            def _w(self):
+                self.term = 0
+
+            def _d(self):
+                self._w()
+
+            def _c(self):
+                self._d()
+
+            def _b(self):
+                self._c()
+
+            def _a(self):
+                self._b()
+
+            async def deep(self, peer):
+                t = self.term
+                await self.send(peer)
+                self._a()
+
+            async def shallow(self, peer):
+                t = self.term
+                await self.send(peer)
+                self._d()
+    """)
+    graph = CallGraph.build({"server/raft_group.py": tree})
+    found = check_await_tear(tree, "server/raft_group.py", graph)
+    assert [f.symbol for f in found] == ["RaftGroup.shallow"]
+
+
+def test_callgraph_ambiguous_module_basename_stays_conservative():
+    # two homonymous modules both define `load`: resolution must refuse
+    # to guess (a wrong never-suspending guess would un-flag a real
+    # interleaving point) — the await stays a suspension and the tear
+    # fires; with the ambiguity removed, the never-suspending resolution
+    # discharges it
+    raft = _tree("""
+        from copycat_tpu.client import state
+
+        class RaftGroup:
+            async def t(self):
+                term = self.term
+                await state.load()
+                self.term = term + 1
+    """)
+    pure_state = _tree("async def load():\n    return 1\n")
+    trees = {"server/raft.py": raft,
+             "client/state.py": pure_state,
+             "server/state.py": _tree("async def load():\n    return 2\n")}
+    graph = CallGraph.build(trees)
+    assert len(check_await_tear(raft, "server/raft.py", graph)) == 1
+    unique = CallGraph.build({"server/raft.py": raft,
+                              "client/state.py": pure_state})
+    assert check_await_tear(raft, "server/raft.py", unique) == []
+
+
+def test_loop_blocking_skips_nested_defs_inside_reachable_sync_helpers():
+    # a nested def inside a sync helper is a callback, not inline code:
+    # reachability must not descend into it (same rule as nested defs
+    # inside async defs — judged where something calls it)
+    tree, graph = _graph("pkg/mod.py", """
+        import shutil
+
+        def helper(tmp, bus):
+            def on_done():
+                shutil.rmtree(tmp)
+            bus.subscribe(on_done)
+
+        async def pump(tmp, bus):
+            helper(tmp, bus)
+    """)
+    assert check_loop_blocking(tree, "pkg/mod.py", graph) == []
+
+
+def test_await_tear_scope_covers_the_deploy_plane():
+    # the compartmentalized tiers run the same ordering contracts in
+    # their own processes — in scope since v2
+    assert check_await_tear(_tree(TEAR), "copycat_tpu/deploy/ingress.py")
+    assert check_await_tear(_tree(TEAR), "copycat_tpu/deploy/supervisor.py")
+    assert check_await_tear(_tree(TEAR), "copycat_tpu/deploy/topology.py") == []
+
+
+# ---------------------------------------------------------------------------
+# durability-order
+# ---------------------------------------------------------------------------
+
+RESOLVE_BEFORE_SYNC = """
+    class RaftGroup:
+        def on_quorum(self, index, result):
+            fut = self._commit_futures.pop(index, None)
+            if fut is not None and not fut.done():
+                fut.set_result((index, result, None))
+            self._sync_log()
+"""
+
+RESOLVE_AFTER_SYNC = """
+    class RaftGroup:
+        def on_quorum(self, index, result):
+            self._sync_log()
+            fut = self._commit_futures.pop(index, None)
+            if fut is not None and not fut.done():
+                fut.set_result((index, result, None))
+"""
+
+
+def test_durability_order_flags_resolve_before_sync():
+    # the seeded fixture from the issue: the future resolves BEFORE the
+    # commit-boundary fsync — an acknowledged write a power loss erases
+    found = check_durability_order(_tree(RESOLVE_BEFORE_SYNC),
+                                   "server/raft_group.py")
+    assert len(found) == 1
+    assert found[0].rule == "durability-order"
+    assert "fut" in found[0].message
+    assert found[0].symbol == "RaftGroup.on_quorum"
+
+
+def test_durability_order_accepts_resolve_dominated_by_sync():
+    assert check_durability_order(_tree(RESOLVE_AFTER_SYNC),
+                                  "server/raft_group.py") == []
+
+
+def test_durability_order_dominance_closes_through_class_callers():
+    # the ack lives in a helper with no sync of its own: discharged
+    # because every same-class caller reaches it past a commit-boundary
+    # sync — and NOT discharged once the helper is also entered from
+    # outside the class (the fused-dispatch seam)
+    src = """
+        class RaftGroup:
+            def advance(self, index, result):
+                self._sync_log()
+                self._resolve(index, result)
+
+            def _resolve(self, index, result):
+                fut = self._commit_futures.pop(index, None)
+                fut.set_result((index, result, None))
+    """
+    assert check_durability_order(_tree(src), "server/raft_group.py") == []
+    found = check_durability_order(_tree(src), "server/raft_group.py",
+                                   external_attr_calls={"_resolve"})
+    assert len(found) == 1 and found[0].symbol == "RaftGroup._resolve"
+
+
+def test_durability_order_exempts_error_resolves_and_other_classes():
+    # a payload naming an msg.ERROR_CODE constant reports failure — it
+    # acknowledges nothing; and the rule is scoped to RaftGroup
+    err = _tree("""
+        class RaftGroup:
+            def reject(self, index):
+                fut = self._commit_futures.pop(index, None)
+                if fut is not None:
+                    fut.set_result((index, None, msg.NO_LEADER))
+    """)
+    assert check_durability_order(err, "server/raft_group.py") == []
+    other = _tree(RESOLVE_BEFORE_SYNC.replace("RaftGroup", "ReadIndexPlane"))
+    assert check_durability_order(other, "server/raft_group.py") == []
+    assert check_durability_order(_tree(RESOLVE_BEFORE_SYNC),
+                                  "client/client.py") == []
+
+
+def test_durability_order_flags_undominated_success_append_ack():
+    tree = _tree("""
+        class RaftGroup:
+            def on_append(self, request):
+                self.log.append_replicated_block(request.entries)
+                return AppendResponse(term=self.term, success=True)
+    """)
+    found = check_durability_order(tree, "server/raft_group.py")
+    assert len(found) == 1 and "success append ack" in found[0].message
+    synced = _tree("""
+        class RaftGroup:
+            def on_append(self, request):
+                self.log.append_replicated_block(request.entries)
+                self._sync_log()
+                return AppendResponse(term=self.term, success=True)
+    """)
+    assert check_durability_order(synced, "server/raft_group.py") == []
+
+
+def test_durability_order_live_tree_carries_only_justified_baselines():
+    result = run_lint(root=REPO, use_cache=False)
+    assert [f for f in result.findings if f.rule == "durability-order"] == []
+    # the fused-dispatch seam findings ride the baseline, each with a
+    # written dominance argument (no TODO placeholders — CI's contract)
+    carried = [f for f in result.baselined if f.rule == "durability-order"]
+    assert carried, "the fused-seam findings should be baselined, not gone"
+    baseline = json.load(open(os.path.join(REPO, ".copycheck-baseline.json")))
+    for entry in baseline["findings"]:
+        assert entry["justification"].strip(), entry
+        assert "TODO" not in entry["justification"], entry
+
+
+# ---------------------------------------------------------------------------
+# span-pairing
+# ---------------------------------------------------------------------------
+
+SPAN_VOCAB_MD = """
+### Span-name vocabulary
+
+| name | phase |
+|---|---|
+| `quorum.wait` | commit |
+| `group.fsync` | commit |
+"""
+
+
+def test_span_pairing_validates_names_against_the_vocabulary():
+    catalog = parse_span_catalog(SPAN_VOCAB_MD)
+    assert catalog == {"quorum.wait", "group.fsync"}
+    tree = _tree("""
+        class G:
+            def ok(self, trace, t0, t1):
+                self._trace_span(trace, "quorum.wait", t0, t1)
+
+            def bad(self, trace, t0, t1):
+                self._trace_span(trace, "quorum.wiat", t0, t1)
+    """)
+    found = check_span_contract(tree, "copycat_tpu/server/raft_group.py",
+                                catalog)
+    assert len(found) == 1
+    assert "quorum.wiat" in found[0].message
+    assert found[0].symbol == "G.bad"
+
+
+def test_span_pairing_forwarding_wrappers_and_dynamic_names():
+    catalog = {"quorum.wait"}
+    # the name is a parameter of the enclosing function: a forwarding
+    # wrapper — its CALLERS are checked instead
+    wrapper = _tree("""
+        class G:
+            def _trace_span(self, trace, name, start, end):
+                self.tracer.span(trace, name, start, end)
+    """)
+    assert check_span_contract(wrapper, "copycat_tpu/server/raft.py",
+                               catalog) == []
+    # any other dynamic name is a finding (it dodges the vocabulary)
+    dynamic = _tree("""
+        class G:
+            def record(self, trace, t0, t1):
+                self.tracer.span(trace, self.pick_name(), t0, t1)
+    """)
+    found = check_span_contract(dynamic, "copycat_tpu/server/raft.py",
+                                catalog)
+    assert len(found) == 1 and "dynamic span name" in found[0].message
+
+
+def test_span_pairing_flags_with_over_span_and_bare_timer():
+    tree = _tree("""
+        class G:
+            def timed(self, trace, metrics, t0, t1):
+                with self.tracer.span(trace, "quorum.wait", t0, t1):
+                    pass
+                metrics.timer("commit_ms")
+                with metrics.timer("commit_ms"):
+                    pass
+    """)
+    found = check_span_contract(tree, "copycat_tpu/server/raft.py",
+                                {"quorum.wait"})
+    msgs = [f.message for f in found]
+    assert len(found) == 2
+    assert any("`with` over a span-record call" in m for m in msgs)
+    assert any("opened and discarded" in m for m in msgs)
+
+
+def test_span_pairing_flags_call_missing_timestamps():
+    # the record family's signature is (trace, name, start, end, ...):
+    # a 3-arg call has no end timestamp — nothing pairable is recorded
+    tree = _tree("""
+        class G:
+            def bad(self, trace, t0):
+                self._trace_span(trace, "quorum.wait", t0)
+    """)
+    found = check_span_contract(tree, "copycat_tpu/server/raft.py",
+                                {"quorum.wait"})
+    assert len(found) == 1 and "fewer than 4" in found[0].message
+
+
+def test_durability_order_error_exemption_is_msg_scoped():
+    # only msg.X constants mark an error resolve; an unrelated all-caps
+    # constant in a SUCCESS payload must not dodge the dominance check
+    tree = _tree("""
+        class RaftGroup:
+            def resolve(self, index):
+                fut = self._commit_futures.pop(index, None)
+                fut.set_result((index, cfg.MAX_INFLIGHT, None))
+    """)
+    found = check_durability_order(tree, "server/raft_group.py")
+    assert len(found) == 1
+
+
+def test_span_pairing_live_tree_names_all_in_vocabulary():
+    catalog = parse_span_catalog(
+        open(os.path.join(REPO, "docs", "OBSERVABILITY.md")).read())
+    assert catalog and "quorum.wait" in catalog
+    result = run_lint(root=REPO, use_cache=False)
+    assert [f for f in result.findings if f.rule == "span-pairing"] == []
+
+
+# ---------------------------------------------------------------------------
+# exit-code
+# ---------------------------------------------------------------------------
+
+
+def test_exit_code_contract_flags_undocumented_codes():
+    codes = parse_exit_codes(
+        open(os.path.join(REPO, "docs", "DEPLOYMENT.md")).read())
+    assert codes == {0, 1, 2}
+    tree = _tree("""
+        import sys
+
+        def main():
+            if bad_config():
+                sys.exit(2)
+            if crashed():
+                sys.exit(1)
+            sys.exit(3)
+    """)
+    found = check_exit_contract(tree, "copycat_tpu/deploy/child.py", codes)
+    assert len(found) == 1
+    assert "exit code 3" in found[0].message
+    # scope: only the deploy-plane mains are under the contract
+    assert check_exit_contract(tree, "copycat_tpu/bench.py", codes) == []
+
+
+def test_exit_code_contract_sees_negative_literals():
+    # sys.exit(-1) is a UnaryOp, not a Constant — and 255 at the
+    # process boundary, squarely in the crash-restart lane
+    tree = _tree("""
+        import sys
+
+        def main():
+            sys.exit(-1)
+    """)
+    found = check_exit_contract(tree, "copycat_tpu/deploy/child.py",
+                                {0, 1, 2})
+    assert len(found) == 1 and "exit code -1" in found[0].message
+    # strings exit with code 1 (the documented crash code) — not flagged
+    s = _tree("import sys\nsys.exit('bad config')\n")
+    assert check_exit_contract(s, "copycat_tpu/deploy/child.py",
+                               {0, 1, 2}) == []
+
+
+def test_exit_code_contract_live_tree_is_clean():
+    result = run_lint(root=REPO, use_cache=False)
+    assert [f for f in result.findings if f.rule == "exit-code"] == []
 
 
 # ---------------------------------------------------------------------------
@@ -613,6 +1148,130 @@ def test_engine_cache_hits_and_invalidates(tmp_path):
     (root / "copycat_tpu" / "mod.py").write_text("async def f():\n    pass\n")
     r3 = run_lint(root=str(root), use_cache=True)
     assert r3.findings == []
+
+
+def test_engine_cache_invalidates_per_rule_group(tmp_path, monkeypatch):
+    """The v2 cache satellite: editing ONE rule module re-lints only its
+    group — every other group's cached results survive."""
+    from copycat_tpu.analysis import engine
+
+    root = _mini_repo(
+        tmp_path, "async def f(loop, c):\n    loop.create_task(c)\n")
+    r1 = run_lint(root=str(root), use_cache=True)
+    assert len(r1.findings) == 1
+
+    import collections
+    counts: collections.Counter = collections.Counter()
+    for spec in engine.RULE_GROUPS:
+        def counted(path, src, tree, ctx, _key=spec.key, _orig=spec.run):
+            counts[_key] += 1
+            return _orig(path, src, tree, ctx)
+
+        monkeypatch.setattr(spec, "run", counted)
+
+    # warm run: every group is a cache hit, nothing recomputes
+    r2 = run_lint(root=str(root), use_cache=True)
+    assert not counts
+    assert [f.to_json() for f in r2.findings] == \
+        [f.to_json() for f in r1.findings]
+
+    # "edit" one rule module: exactly that group recomputes
+    real = engine._analysis_source
+    monkeypatch.setattr(
+        engine, "_analysis_source",
+        lambda mod: real(mod) + ("\n# edited" if mod == "rules_wire.py"
+                                 else ""))
+    r3 = run_lint(root=str(root), use_cache=True)
+    assert set(counts) == {"wire"}
+    assert [f.to_json() for f in r3.findings] == \
+        [f.to_json() for f in r1.findings]
+
+
+def test_sarif_emitter_levels_and_suppressions(tmp_path):
+    from copycat_tpu.analysis.engine import render_sarif
+
+    root = _mini_repo(tmp_path, (
+        "async def f(loop, c):\n"
+        "    loop.create_task(c)\n"
+        "    loop.create_task(c)  # copycheck: ignore[orphan-task] test\n"))
+    result = run_lint(root=str(root), use_cache=False)
+    assert len(result.findings) == 1 and len(result.suppressed) == 1
+    doc = json.loads(render_sarif(result))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "copycheck"
+    assert {"id": "orphan-task"} in run["tool"]["driver"]["rules"]
+    live = [r for r in run["results"] if "suppressions" not in r]
+    sup = [r for r in run["results"] if "suppressions" in r]
+    assert len(live) == 1 and live[0]["level"] == "error"
+    assert live[0]["ruleId"] == "orphan-task"
+    loc = live[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "copycat_tpu/mod.py"
+    assert loc["region"]["startLine"] == 2
+    assert live[0]["partialFingerprints"]["copycheckIdentity/v1"]
+    assert len(sup) == 1
+    assert sup[0]["suppressions"] == [{"kind": "inSource"}]
+    assert sup[0]["level"] == "note"
+
+
+def _git(root, *argv):
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    *argv], cwd=root, check=True, capture_output=True)
+
+
+def test_changed_mode_filters_findings_to_the_diff(tmp_path):
+    root = _mini_repo(
+        tmp_path, "async def f(loop, c):\n    loop.create_task(c)\n")
+    _git(root, "init", "-q")
+    _git(root, "add", "-A")
+    _git(root, "commit", "-qm", "seed")
+    # an UNTRACKED module with a violation: the diff gate must see it
+    (root / "copycat_tpu" / "fresh.py").write_text(
+        "async def g(loop, c):\n    loop.create_task(c)\n")
+    full = run_lint(root=str(root), use_cache=False)
+    assert sorted(f.path for f in full.findings) == [
+        "copycat_tpu/fresh.py", "copycat_tpu/mod.py"]
+    diff = run_lint(root=str(root), use_cache=False, changed_base="HEAD")
+    assert diff.changed_files == ["copycat_tpu/fresh.py"]
+    # the committed file's finding is out of scope; analysis still ran
+    # package-wide (files count is the whole tree)
+    assert [f.path for f in diff.findings] == ["copycat_tpu/fresh.py"]
+    assert diff.files == full.files
+
+
+def test_changed_mode_uses_merge_base_not_two_dot(tmp_path):
+    # a branch BEHIND the base rev must not inherit files only the
+    # base's own history changed (two-dot `git diff BASE` would)
+    root = _mini_repo(
+        tmp_path, "async def f(loop, c):\n    loop.create_task(c)\n")
+    _git(root, "init", "-q", "-b", "main")
+    _git(root, "add", "-A")
+    _git(root, "commit", "-qm", "seed")
+    _git(root, "branch", "feature")
+    # main moves ahead with its own violating module...
+    (root / "copycat_tpu" / "mainonly.py").write_text(
+        "async def m(loop, c):\n    loop.create_task(c)\n")
+    _git(root, "add", "-A")
+    _git(root, "commit", "-qm", "main moves on")
+    # ...while the PR branch (behind main) adds just its own file
+    _git(root, "checkout", "-q", "feature")
+    (root / "copycat_tpu" / "fresh.py").write_text(
+        "async def g(loop, c):\n    loop.create_task(c)\n")
+    diff = run_lint(root=str(root), use_cache=False,
+                    changed_base="main")
+    assert diff.changed_files == ["copycat_tpu/fresh.py"]
+    assert [f.path for f in diff.findings] == ["copycat_tpu/fresh.py"]
+
+
+def test_write_baseline_refuses_changed_scope(tmp_path, capsys):
+    from copycat_tpu.analysis.engine import main as lint_main
+
+    import pytest
+    with pytest.raises(SystemExit) as exc:
+        lint_main(["--write-baseline", "--changed", "HEAD"])
+    assert exc.value.code == 2
+    assert "--write-baseline needs the full-tree view" in \
+        capsys.readouterr().err
 
 
 def test_cli_lint_exit_codes(tmp_path):
