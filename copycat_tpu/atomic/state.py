@@ -98,6 +98,18 @@ class AtomicValueState(ResourceStateMachine):
     # live change listeners — opt out (NotImplemented), keeping the whole
     # server on replay-only recovery instead of a lossy image.
 
+    def edge_state(self) -> Any:
+        # the whole register IS the state: one tagged value per delta
+        # (docs/EDGE_READS.md) — `Get` evaluates client-side as identity.
+        # An armed TTL expires via an executor timer OUTSIDE any command
+        # apply, where the delta plane's dirty marking cannot see it —
+        # refresh records would certify the expired value indefinitely —
+        # so TTL'd state opts out (subscribers are retired), the same
+        # rule snapshot_state applies.
+        if self._timer is not None:
+            return NotImplemented
+        return ("val", self.value)
+
     def snapshot_state(self) -> Any:
         if self._timer is not None or self._listeners:
             return NotImplemented

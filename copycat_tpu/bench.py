@@ -62,6 +62,14 @@ Scenarios (``COPYCAT_BENCH_SCENARIO``, BASELINE.md benchmark configs):
   to a loaded, compacted cluster via snapshot-install streaming vs full
   log replay (``COPYCAT_SNAPSHOTS`` A/B inside one run); headline value
   is the catch-up speedup, with ``snap.*`` metrics in the artifact.
+- ``fanout``: the edge read tier scenario (docs/EDGE_READS.md) — few
+  writers, a sweep of reader-session counts over zipfian counters;
+  with ``COPYCAT_EDGE_READS`` on, SEQUENTIAL reads serve from
+  client-local CRDT replicas fed by per-resource deltas and reads/s
+  scales with the reader count while cluster commits stay flat; the
+  knob-off lane pins reads/s to server read capacity (the A/B).
+  The artifact embeds the cache-served-read trace proof (client-side
+  spans only) and the aggregated ``edge.*`` client family.
 """
 
 from __future__ import annotations
@@ -991,6 +999,211 @@ def run_readmix() -> dict:
             }
         finally:
             await _close_spi_stack(client, server)
+
+    return asyncio.run(drive())
+
+
+def run_fanout() -> dict:
+    """Edge read tier bench (docs/EDGE_READS.md): few writers, a sweep
+    of reader-session counts, a zipfian key mix — the
+    millions-of-readers shape in miniature. With ``COPYCAT_EDGE_READS``
+    on (default), each reader's first SEQUENTIAL read per counter
+    subscribes and seeds its client-local replica; every later read
+    serves from it, so read throughput scales with the reader count
+    while the cluster sees only the writers' commits and the
+    (reader-count-bounded) seed reads. With the knob off, every read
+    pays the server round-trip and reads/s is pinned to the server's
+    read-window capacity — the A/B this scenario exists to measure.
+
+    The artifact also carries the trace proof: a cache-served read's
+    assembled trace consists solely of client-side spans
+    (``client.edge_serve`` — no ``proxy.hop``, no ``quorum.wait``)."""
+    import asyncio
+    import random as _random
+
+    from .atomic import DistributedAtomicLong
+    from .io.local import LocalServerRegistry, LocalTransport
+    from .io.transport import Address
+    from .manager.atomix import AtomixClient, AtomixServer
+    from .resource.consistency import Consistency
+    from .utils import tracing
+    from .utils.tasks import spawn
+
+    edge_on = knobs.get_bool("COPYCAT_EDGE_READS")
+    reader_counts = [int(x) for x in knobs.get_str(
+        "COPYCAT_BENCH_FANOUT_READERS").split(",") if x.strip()]
+    writers = knobs.get_int("COPYCAT_BENCH_FANOUT_WRITERS")
+    n_keys = knobs.get_int("COPYCAT_BENCH_FANOUT_KEYS")
+    reads_per_reader = knobs.get_int("COPYCAT_BENCH_FANOUT_READS")
+    bursts = knobs.get_int("COPYCAT_BENCH_FANOUT_BURSTS")
+    zipf_s = knobs.get_float("COPYCAT_BENCH_FANOUT_ZIPF")
+    rng = _random.Random(17)
+    draw_rank = zipf_sampler(rng, n_keys, zipf_s)
+
+    async def drive() -> dict:
+        registry = LocalServerRegistry()
+        addr = Address("127.0.0.1", 15997)
+        # the coordination-plane shape: CPU machines, one member — the
+        # cluster is deliberately NOT the interesting axis here, the
+        # client-side replica is
+        server = AtomixServer(addr, [addr], LocalTransport(registry),
+                              election_timeout=0.5,
+                              heartbeat_interval=0.1,
+                              session_timeout=60.0)
+        await server.open()
+        writer_clients = [AtomixClient([addr], LocalTransport(registry),
+                                       session_timeout=60.0)
+                          for _ in range(writers)]
+        await asyncio.gather(*(c.open() for c in writer_clients))
+        readers: list[AtomixClient] = []
+        try:
+            writer_ctrs = [
+                await asyncio.gather(
+                    *(c.get(f"ctr{k}", DistributedAtomicLong)
+                      for k in range(n_keys)))
+                for c in writer_clients]
+            log(f"bench[fanout]: edge reads "
+                f"{'ON' if edge_on else 'OFF'}; {writers} writers, "
+                f"{n_keys} keys, readers sweep {reader_counts}")
+            _bench_gc_tune()
+            sweep: dict[str, dict] = {}
+            reps_largest: list[float] = []
+            write_stop = [False]
+            writes_done = [0]
+
+            async def write_loop(ctrs) -> None:
+                while not write_stop[0]:
+                    await ctrs[draw_rank()].add_and_get(1)
+                    writes_done[0] += 1
+
+            async def reader_session() -> None:
+                c = AtomixClient([addr], LocalTransport(registry),
+                                 session_timeout=60.0)
+                await c.open()
+                readers.append(c)
+
+            def server_reads() -> int:
+                snap = server.server.metrics.snapshot()
+                return sum(v for k, v in snap.items()
+                           if isinstance(v, (int, float))
+                           and str(k).startswith("query_reads"))
+
+            for count in reader_counts:
+                while len(readers) < count:
+                    grow = min(64, count - len(readers))
+                    await asyncio.gather(
+                        *(reader_session() for _ in range(grow)))
+                plans = []
+                for c in readers[:count]:
+                    keys = [draw_rank() for _ in range(reads_per_reader)]
+                    cached = {}
+                    for k in set(keys):
+                        if k not in cached:
+                            h = await c.get(f"ctr{k}",
+                                            DistributedAtomicLong)
+                            h.with_consistency(Consistency.SEQUENTIAL)
+                            cached[k] = h
+                    plans.append([cached[k] for k in keys])
+
+                async def read_plan(plan) -> None:
+                    for h in plan:
+                        await h.get()
+
+                burst_reads = count * reads_per_reader
+                reps = []
+                for rep in range(bursts):
+                    write_stop[0] = False
+                    writes_done[0] = 0
+                    wtasks = [spawn(write_loop(cs), name="fanout-writer")
+                              for cs in writer_ctrs]
+                    reads_before = server_reads()
+                    t0 = time.perf_counter()
+                    await asyncio.gather(*(read_plan(p) for p in plans))
+                    dt = time.perf_counter() - t0
+                    write_stop[0] = True
+                    await asyncio.gather(*wtasks)
+                    reads_s = burst_reads / dt
+                    reps.append(reads_s)
+                    log(f"bench[fanout]: {count} readers rep {rep}: "
+                        f"{burst_reads} reads in {dt:.3f}s -> "
+                        f"{reads_s:,.0f} reads/s; "
+                        f"{writes_done[0] / dt:,.0f} committed writes/s; "
+                        f"{server_reads() - reads_before} server reads")
+                    if count == reader_counts[-1]:
+                        last = (dt, writes_done[0],
+                                server_reads() - reads_before)
+                sweep[str(count)] = {
+                    "reads_per_sec": round(max(reps), 1),
+                    "reps": [round(r, 1) for r in reps],
+                }
+                if count == reader_counts[-1]:
+                    reps_largest = reps
+                    dt, wd, sr = last
+                    sweep[str(count)]["committed_writes_per_sec"] = \
+                        round(wd / dt, 1)
+                    sweep[str(count)]["server_reads_last_rep"] = sr
+
+            # trace proof: a cache-served read's assembled trace is
+            # client-side only (no proxy.hop / quorum.wait / group.*)
+            trace_proof = None
+            if edge_on:
+                tracing.enable()
+                try:
+                    await plans[0][0].get()  # warmed: serves locally
+                    proof_id = next(
+                        (tid for tid, spans in tracing.TRACER.traces().items()
+                         if any(s.name == "client.edge_serve"
+                                for s in spans)), None)
+                    if proof_id is not None:
+                        spans = tracing.TRACER.spans_for(proof_id)
+                        assembly = tracing.assemble_trace(
+                            proof_id,
+                            {"client": [s.as_dict() for s in spans]})
+                        names = sorted({s.name for s in spans})
+                        trace_proof = {
+                            "spans": names,
+                            "members": assembly.get("members", []),
+                            "client_only": all(
+                                n.startswith("client.") for n in names),
+                            "incomplete": assembly.get("incomplete"),
+                        }
+                finally:
+                    tracing.disable()
+
+            # aggregate the reader clients' edge families for the
+            # artifact (the CI smoke asserts these keys)
+            agg: dict[str, float] = {}
+            for c in readers:
+                for k, v in c.client.metrics.snapshot().items():
+                    if str(k).startswith("edge.") \
+                            and isinstance(v, (int, float)):
+                        agg[str(k)] = agg.get(str(k), 0) + v
+            METRICS_SNAPSHOTS["server"] = server.server.stats_snapshot()
+            METRICS_SNAPSHOTS["edge_clients"] = agg
+            largest = reader_counts[-1]
+            best = max(reps_largest)
+            return {
+                "metric": (f"fanout_reads_per_sec_{largest}_readers"
+                           + ("" if edge_on else "_server")),
+                "value": round(best, 1),
+                "unit": "reads/sec",
+                "vs_baseline": round(best / NORTH_STAR_OPS, 4),
+                "edge_reads": edge_on,
+                "readers": reader_counts,
+                "writers": writers,
+                "keys": n_keys,
+                "sweep": sweep,
+                "trace": trace_proof,
+                **spread(reps_largest),
+            }
+        finally:
+            write_stop[0] = True
+            for c in readers + writer_clients:
+                try:
+                    await asyncio.wait_for(c.close(), 5)
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+            await asyncio.wait_for(server.close(), 10)
 
     return asyncio.run(drive())
 
@@ -2467,6 +2680,8 @@ def main() -> None:
         result = run_recovery()
     elif SCENARIO == "compartment":
         result = run_compartment()
+    elif SCENARIO == "fanout":
+        result = run_fanout()
     elif SCENARIO == "session":
         result = run_session()
     elif SCENARIO in SUBMIT_BUILDERS:
@@ -2474,7 +2689,7 @@ def main() -> None:
     else:
         raise SystemExit(
             f"unknown scenario {SCENARIO!r}; pick one of "
-            f"{['election', 'map_read', 'host', 'host_read', 'spi', 'readmix', 'cluster', 'sharded', 'apply', 'recovery', 'compartment', 'session', *SUBMIT_BUILDERS]}")
+            f"{['election', 'map_read', 'host', 'host_read', 'spi', 'readmix', 'cluster', 'sharded', 'apply', 'recovery', 'compartment', 'fanout', 'session', *SUBMIT_BUILDERS]}")
     if degraded:
         result["degraded"] = True
     if args.metrics_json:

@@ -196,6 +196,16 @@ class RaftClient(Managed):
         self._follower_reads = knobs.get_bool("COPYCAT_CLIENT_FOLLOWER_READS")
         self._read_connections: dict[Address, Connection] = {}
         self._read_rr = 0
+        # Edge read tier (docs/EDGE_READS.md): client-local CRDT
+        # replicas serving CAUSAL/SEQUENTIAL reads without a server
+        # hop, fed by per-resource deltas over the session event
+        # channel. COPYCAT_EDGE_READS=0 removes the tier entirely — no
+        # replica, no subscribe fields, the server-read plane
+        # bit-identically (the A/B discipline).
+        self._edge = None
+        if knobs.get_bool("COPYCAT_EDGE_READS"):
+            from .edge import EdgeReadTier
+            self._edge = EdgeReadTier(self)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -392,6 +402,8 @@ class RaftClient(Managed):
     async def _send_keepalive(self) -> None:
         if not self._session.is_open:
             return
+        unsub = (self._edge.take_unsubscribes()
+                 if self._edge is not None else None)
         try:
             session = self._session
             event_index: Any = (session.event_index
@@ -401,13 +413,18 @@ class RaftClient(Managed):
                 msg.KeepAliveRequest(
                     session_id=session.id,
                     command_seq=self._acked_command_seq,
-                    event_index=event_index),
+                    event_index=event_index,
+                    unsubscribe=unsub),
                 # timeout/4 = the keep-alive interval: a stuck attempt
                 # yields to the next tick's re-route, and the floor
                 # keeps slow-but-healthy commits (hundreds of ms) from
                 # spuriously dropping the shared connection
                 per_try_timeout=max(1.0, self._session.timeout / 4.0))
         except (msg.ProtocolError, TransportError, OSError, asyncio.TimeoutError):
+            if self._edge is not None:
+                # retiring a subscription is idempotent: re-stage for
+                # the next tick instead of leaking the registry entry
+                self._edge.restage_unsubscribes(unsub)
             return
         if response.error == msg.UNKNOWN_SESSION:
             self._session._expired()
@@ -423,6 +440,14 @@ class RaftClient(Managed):
         g = getattr(request, "group", None) or 0
         position = session._event_indices.get(g, 0)
         if request.session_id != session.id:
+            return msg.PublishResponse(event_index=position)
+        deltas = getattr(request, "deltas", None)
+        if deltas and self._edge is not None:
+            # edge state deltas (docs/EDGE_READS.md): merged BEFORE the
+            # event-channel gap check — the CRDT merge needs no position
+            self._edge.ingest(deltas, trace)
+        if request.event_index is None:
+            # delta-only push: the event channel's position is untouched
             return msg.PublishResponse(event_index=position)
         if request.prev_event_index != position:
             # Gap or replay: report our position; the server resends from there.
@@ -589,6 +614,16 @@ class RaftClient(Managed):
             raise SessionExpiredError("session is not open")
         self.metrics.counter("queries_submitted").inc()
         consistency = operation.consistency().value
+        edge = self._edge
+        if edge is not None and consistency not in (
+                "linearizable", "bounded_linearizable"):
+            # edge fast path (docs/EDGE_READS.md): a warm replica
+            # serves SYNCHRONOUSLY — no future, no micro-batch flush,
+            # no wire round-trip; misses fall through to the staged
+            # server path (which subscribes + seeds)
+            result = edge.try_serve(operation)
+            if result is not edge.MISS:
+                return result
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         self._pending_queries.setdefault(consistency, []).append(
@@ -610,15 +645,27 @@ class RaftClient(Managed):
                                  items: list) -> None:
         leader_required = consistency in ("linearizable",
                                           "bounded_linearizable")
+        # Edge read tier (docs/EDGE_READS.md): these reads already
+        # missed the replica (the fast path in _submit_query serves
+        # hits synchronously) — edge-shaped misses carry the
+        # `subscribe` flag and route over the SESSION connection (the
+        # member that pushes this session's deltas), so the response
+        # seeds the replica and later reads stay local.
+        edge = self._edge if not leader_required else None
+        subscribe = (1 if edge is not None and edge.wants_subscribe(items)
+                     else None)
         # every read is tagged with its consistency (the request field);
         # sub-linearizable levels route round-robin across replicas
+        # (subscribing reads excepted — deltas flow over the session
+        # connection, so the subscription must land on its holder)
         round_robin = (not leader_required and self._follower_reads
-                       and len(self.members) > 1)
+                       and subscribe is None and len(self.members) > 1)
         if len(items) == 1:
             operation, fut = items[0]
             request = msg.QueryRequest(
                 session_id=self._session.id, index=self._read_index(),
-                operation=operation, consistency=consistency)
+                operation=operation, consistency=consistency,
+                subscribe=subscribe)
             try:
                 if round_robin:
                     response = await self._request_read(request)
@@ -630,6 +677,8 @@ class RaftClient(Managed):
                 if not fut.done():
                     fut.set_exception(e)
                 return
+            if subscribe is not None and edge is not None:
+                edge.seed_response(items, getattr(response, "edge", None))
             if not fut.done():
                 fut.set_result(result)
             return
@@ -637,7 +686,8 @@ class RaftClient(Managed):
             request = msg.QueryBatchRequest(
                 session_id=self._session.id, index=self._read_index(),
                 consistency=consistency,
-                operations=[op for op, _ in items])
+                operations=[op for op, _ in items],
+                subscribe=subscribe)
             if round_robin:
                 response = await self._request_read(request)
             else:
@@ -650,6 +700,8 @@ class RaftClient(Managed):
                 if not fut.done():
                     fut.set_exception(e)
             return
+        if subscribe is not None and edge is not None:
+            edge.seed_response(items, getattr(response, "edge", None))
         try:
             self._note_index(response.index)
             entries = response.entries or []

@@ -157,6 +157,16 @@ class MapState(ResourceStateMachine):
         self._map.clear()
         commit.clean()
 
+    def edge_state(self) -> Any:
+        # full-state delta (docs/EDGE_READS.md): the v1 granularity is
+        # the whole map per delta — the state-based-CRDT model exactly;
+        # per-key delta states are the documented future refinement.
+        # Armed TTL timers expire outside the apply path (invisible to
+        # the delta plane's dirty marking): opt out, like snapshots.
+        if any(h.timer is not None for h in self._map.values()):
+            return NotImplemented
+        return ("map", {k: h.value for k, h in self._map.items()})
+
     def delete(self) -> None:
         for held in self._map.values():
             held.discard()
@@ -320,6 +330,12 @@ class SetState(ResourceStateMachine):
             held.discard()
         self._set.clear()
         commit.clean()
+
+    def edge_state(self) -> Any:
+        # TTL'd members expire outside the apply path: opt out (see map)
+        if any(h.timer is not None for h in self._set.values()):
+            return NotImplemented
+        return ("set", list(self._set.keys()))
 
     def delete(self) -> None:
         for held in self._set.values():

@@ -908,6 +908,19 @@ class DeviceAtomicValueState(DeviceBackedStateMachine):
             self._held = _Held(stand_in, value=held["value"],
                                on_device=held["on_device"])
 
+    # -- edge read tier (docs/EDGE_READS.md) -------------------------------
+    # The post-apply state row: device-resident values answer through
+    # one device query (evaluated at delta-flush time, after the turn's
+    # fused rows landed), host shadows answer from host state. An armed
+    # TTL expires via a timer outside the apply path — invisible to the
+    # delta plane's dirty marking — so TTL'd state opts out, retiring
+    # its subscribers (the snapshot_state rule).
+
+    def edge_state(self) -> Any:
+        if self._timer is not None:
+            return NotImplemented
+        return ("val", self._value())
+
     # -- vector lane (batched server-side pump) ---------------------------
     # Eligible only in the steady device-resident state: value held ON
     # DEVICE, no TTL timer armed, no change listeners, devint payloads,
@@ -1204,6 +1217,49 @@ class DeviceMapState(DeviceBackedStateMachine):
         self._held.clear()
         commit.clean()
 
+    # -- snapshot hooks (crash-recovery plane, docs/DURABILITY.md) --------
+    # The device probe table rides the engine's checkpoint blob; the
+    # host bookkeeping is one record per key (device residency flag +
+    # the host-shadow value). Armed per-key TTL timers hold commit
+    # references that cannot round-trip — opt out (NotImplemented) and
+    # keep the whole manager on replay-only recovery, like the value
+    # machine.
+
+    def snapshot_state(self) -> Any:
+        if any(h.timer is not None for h in self._held.values()):
+            return NotImplemented
+        return {"held": [(k, h.on_device,
+                          None if h.on_device else h.value)
+                         for k, h in self._held.items()]}
+
+    def restore_state(self, data: Any, sessions: dict) -> None:
+        for key, on_device, value in data["held"]:
+            # creating commits are behind the snapshot boundary: log-less
+            # stand-ins (clean() is a no-op) keep the retained-commit
+            # discipline
+            self._held[key] = _Held(Commit(0, None, 0.0, None, None),
+                                    value=value, on_device=on_device)
+
+    # -- edge read tier (docs/EDGE_READS.md): full-state delta ------------
+    # Armed per-key TTLs opt out (timers fire outside the apply path —
+    # the value machine's rule); device-resident values gather through
+    # ONE batched query_step round, not a blocking round per key (this
+    # runs on the apply plane's event loop every delta flush).
+
+    def edge_state(self) -> Any:
+        if any(h.timer is not None for h in self._held.values()):
+            return NotImplemented
+        out = {k: h.value for k, h in self._held.items()
+               if not h.on_device}
+        dev_keys = [k for k, h in self._held.items() if h.on_device]
+        if dev_keys:
+            n = len(dev_keys)
+            raws = self._eng.run_query_vector(
+                [self._group] * n, [ops().OP_MAP_GET] * n, dev_keys,
+                [0] * n, [0] * n)
+            out.update(zip(dev_keys, raws))
+        return ("map", out)
+
     def delete(self) -> None:
         def chain():
             if any(h.on_device for h in self._held.values()):
@@ -1293,6 +1349,31 @@ class DeviceSetState(DeviceBackedStateMachine):
             held.discard()
         self._held.clear()
         commit.clean()
+
+    # -- snapshot hooks (crash-recovery plane, docs/DURABILITY.md) --------
+    # Same shape as the map machine: members on the device table ride
+    # the engine blob, host shadows serialize here; armed TTL timers
+    # opt the machine out.
+
+    def snapshot_state(self) -> Any:
+        if any(h.timer is not None for h in self._held.values()):
+            return NotImplemented
+        return {"held": [(v, h.on_device) for v, h in self._held.items()]}
+
+    def restore_state(self, data: Any, sessions: dict) -> None:
+        for value, on_device in data["held"]:
+            self._held[value] = _Held(Commit(0, None, 0.0, None, None),
+                                      value=None if on_device else value,
+                                      on_device=on_device)
+
+    # -- edge read tier (docs/EDGE_READS.md): full-state delta ------------
+    # (membership is host-authoritative — `contains` never queries the
+    # device — so no device round is needed; TTLs opt out as above)
+
+    def edge_state(self) -> Any:
+        if any(h.timer is not None for h in self._held.values()):
+            return NotImplemented
+        return ("set", list(self._held.keys()))
 
     def delete(self) -> None:
         def chain():

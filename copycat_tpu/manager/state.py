@@ -361,6 +361,35 @@ class ResourceManager(StateMachine):
             return None
         return machine, instance, inner, spec
 
+    # -- edge read tier (docs/EDGE_READS.md) -------------------------------
+
+    def edge_locate(self, operation: Any):
+        """``(resource_id, instance_id)`` when ``operation`` is a routed
+        resource read of a live instance — the subscription handle the
+        edge tier registers under (deltas are keyed by the RESOURCE the
+        apply path mutates, :meth:`apply_key`; the client addresses its
+        replica by the instance id it queries through). ``None``
+        otherwise. Exact-type checks keep subclasses on the server
+        path, like :meth:`query_route`."""
+        if type(operation) is not InstanceQuery:
+            return None
+        if type(operation.operation) is not ResourceQuery:
+            return None
+        instance = self.instances.get(operation.resource)
+        if instance is None:
+            return None
+        return instance.resource.resource_id, operation.resource
+
+    def edge_state_of(self, resource_id: int) -> Any:
+        """Tagged edge state of one resource (the machine's
+        ``edge_state`` hook): ``NotImplemented`` when the machine never
+        serves edge reads, ``None`` when the resource is gone — the
+        subscriber's replica entry must retire."""
+        holder = self.resources.get(resource_id)
+        if holder is None:
+            return None
+        return holder.state_machine.edge_state()
+
     # -- internals ---------------------------------------------------------
 
     def _get_or_create_resource(self, commit: Commit, key: str,

@@ -54,6 +54,7 @@ from typing import Any, Callable, NamedTuple
 
 import numpy as np
 
+from ..utils import knobs
 from ..utils.listeners import Listener, Listeners
 from .bulk import BulkDriver
 from .sessions import DeviceSession, SessionExpiredError
@@ -82,6 +83,117 @@ class SessionEvent(NamedTuple):
 #: result-cache sentinels (identity-compared in BulkSession.result)
 _INDETERMINATE = object()
 _EXPIRED = object()
+
+
+class _EdgeValueCache:
+    """Device-plane edge replica (docs/EDGE_READS.md): the post-apply
+    state rows of this client's OWN committed value-pool writes, served
+    back to CAUSAL-level reads without an engine round.
+
+    On the device plane a Raft group IS the resource, and a committed
+    write's post-apply register value is derivable from ``(opcode,
+    operands, result)`` — SET/GET_AND_SET install their operand, CAS
+    installs its update iff the result says it swapped, LONG_ADD
+    returns the new value outright. Read-your-writes and monotone reads
+    hold per client by construction (every committed write of this
+    client passes through :meth:`observe` in batch order); freshness
+    against OTHER processes' writes is exactly what CAUSAL does not
+    promise — SEQUENTIAL and above always drive the engine. An
+    abandoned drive purges the cache: its ops are INDETERMINATE, and
+    serving a pre-abandon row would hide a write that may have applied
+    (the correlate-a-fresh-read recovery contract).
+
+    Only groups the client actually reads through the causal lane are
+    tracked (the interest set), so write-only workloads pay one
+    truthiness check per flush.
+    """
+
+    __slots__ = ("state", "interest", "ttl_groups", "_m_serves",
+                 "_m_fallbacks", "_m_merges", "_m_purges")
+
+    def __init__(self, metrics: Any) -> None:
+        self.state: dict[int, int] = {}
+        self.interest: set[int] = set()
+        # groups that ever armed a device-side TTL (OP_VALUE_SET with a
+        # ttl-ticks operand): the engine will clear them at a deadline
+        # the host cannot observe, so they are permanently uncacheable
+        self.ttl_groups: set[int] = set()
+        self._m_serves = metrics.counter("edge.local_serves")
+        self._m_fallbacks = metrics.counter("edge.server_fallbacks")
+        self._m_merges = metrics.counter("edge.merges")
+        self._m_purges = metrics.counter("edge.purges")
+
+    def observe(self, groups: np.ndarray, opcode: np.ndarray,
+                a: np.ndarray, b: np.ndarray, c: np.ndarray,
+                results: np.ndarray) -> None:
+        """Fold one committed chunk's value-pool writes into the
+        replica (vectorized; called from the flush's correlate pass)."""
+        if not self.interest:
+            return
+        from ..ops import apply as ops
+        watched = np.isin(groups, np.fromiter(self.interest, np.int64))
+        if not watched.any():
+            return
+        is_set = opcode == ops.OP_VALUE_SET
+        # a TTL'd set expires ON DEVICE at a log-time deadline this
+        # cache cannot see (ops/apply.py: the register then reads as
+        # unset) — blacklist the group from caching outright
+        ttl = watched & is_set & (c != 0)
+        if ttl.any():
+            for g in groups[ttl].tolist():
+                self.ttl_groups.add(int(g))
+                self.state.pop(int(g), None)
+        is_gas = opcode == ops.OP_VALUE_GET_AND_SET
+        is_add = opcode == ops.OP_LONG_ADD
+        is_cas = (opcode == ops.OP_VALUE_CAS) & (results == 1)
+        mask = watched & (is_set | is_gas | is_add | is_cas)
+        if self.ttl_groups:
+            mask &= ~np.isin(groups,
+                             np.fromiter(self.ttl_groups, np.int64))
+        if not mask.any():
+            return
+        value = np.where(is_add, results, np.where(is_cas, b, a))
+        for g, v in zip(groups[mask].tolist(), value[mask].tolist()):
+            self.state[int(g)] = int(v)
+        self._m_merges.inc(int(mask.sum()))
+
+    def serve(self, groups: np.ndarray) -> np.ndarray | None:
+        """All-or-nothing local serve of one GET batch; ``None`` falls
+        back to the engine's query lane (and marks interest so future
+        flushes feed these groups)."""
+        state = self.state
+        out = np.empty(groups.size, np.int64)
+        for k, g in enumerate(groups.tolist()):
+            v = state.get(int(g))
+            if v is None:
+                self.interest.update(int(x) for x in groups.tolist())
+                self._m_fallbacks.inc(int(groups.size))
+                return None
+            out[k] = v
+        self._m_serves.inc(int(groups.size))
+        return out
+
+    def refresh_from_reads(self, groups: np.ndarray,
+                           results: np.ndarray) -> None:
+        """Fold an ENGINE-served GET's results back into the replica:
+        the engine's answer is at-least-as-new as anything cached, so
+        this keeps mixed-level read sequences monotone — a session
+        that observed a foreign writer's value through a SEQUENTIAL
+        read must never see an older cached value from a later CAUSAL
+        read."""
+        if not self.interest:
+            return
+        for g, v in zip(groups.tolist(), results.tolist()):
+            g = int(g)
+            if g in self.interest and g not in self.ttl_groups:
+                self.state[g] = int(v)
+
+    def purge(self) -> None:
+        """Drop every cached row (abandoned drive: ops may or may not
+        have applied; the next read must come from the engine)."""
+        if self.state:
+            self.state.clear()
+            self._m_purges.inc()
 
 #: SPI read-consistency vocabulary -> device query lane level. The
 #: device lane has two serving regimes (leader applied state; leader
@@ -220,8 +332,28 @@ class BulkSession:
         self._client._rg.metrics.counter(
             "session_reads", consistency=consistency).inc(int(g.size))
         self._client._registry.keep_alive(self.id)
-        return self._client._driver.drive_queries(
+        edge = self._client._edge
+        all_get = False
+        if edge is not None:
+            from ..ops import apply as ops
+            all_get = bool(np.all(np.asarray(opcode) == ops.OP_VALUE_GET))
+            if all_get and consistency in ("causal", "none", "process"):
+                # edge read tier (docs/EDGE_READS.md): CAUSAL-level GETs
+                # may serve from the client's replica of its own
+                # committed post-apply state rows — no engine round.
+                # SEQUENTIAL and above always drive (cross-process
+                # freshness).
+                served = edge.serve(g)
+                if served is not None:
+                    return served
+        out = self._client._driver.drive_queries(
             g, opcode, a, b, c, consistency=level)
+        if edge is not None and all_get:
+            # engine-served answers refresh the replica so a later
+            # causal read can never regress behind what this session
+            # just observed (mixed-level monotonicity)
+            edge.refresh_from_reads(g, out)
+        return out
 
     # -- events ------------------------------------------------------------
 
@@ -275,6 +407,10 @@ class BulkSessionClient:
         self._registry = rg.sessions            # instantiates lazily
         self._sessions: dict[int, BulkSession] = {}
         self._closed: list[BulkSession] = []
+        # the device-plane edge replica (docs/EDGE_READS.md); the same
+        # COPYCAT_EDGE_READS knob removes it bit-identically
+        self._edge = (_EdgeValueCache(rg.metrics)
+                      if knobs.get_bool("COPYCAT_EDGE_READS") else None)
 
     # -- sessions ----------------------------------------------------------
 
@@ -374,6 +510,10 @@ class BulkSessionClient:
                         cleanup + self._registry.pending_cleanup)
                 if (isinstance(exc, TimeoutError)
                         or rg._next_tag != tag_mark):
+                    if self._edge is not None:
+                        # the abandoned ops may have applied: a cached
+                        # row could hide a write RYW must surface
+                        self._edge.purge()
                     # Abandoned drive (fault-envelope violation), or any
                     # error raised AFTER the drive reserved its tag block
                     # — device dispatch may have begun, so the commands
@@ -415,6 +555,10 @@ class BulkSessionClient:
                 n = ch.groups.size
                 if s is not None:
                     vals = res.results[off:off + n]
+                    if self._edge is not None:
+                        # post-apply state rows feed the edge replica
+                        self._edge.observe(ch.groups, ch.opcode, ch.a,
+                                           ch.b, ch.c, vals)
                     s._results.update(
                         zip(range(ch.seq0, ch.seq0 + n), vals.tolist()))
                     committed += n
@@ -484,6 +628,8 @@ class BulkSessionClient:
         reuse is impossible. Call after restoring delivery (faults
         healed); then flush as normal. Abandoned commands stay
         indeterminate (read the state to learn their fate)."""
+        if self._edge is not None:
+            self._edge.purge()
         self._driver.recover(settle_rounds=settle_rounds)
 
     def close(self) -> None:

@@ -172,6 +172,14 @@ class DeviceSessionRegistry:
                 self._submit_cleanup(group, ops.OP_ELECT_RESIGN, sid)
 
     def _submit_cleanup(self, group: int, opcode: int, sid: int) -> None:
+        # Cleanup fan-out is lock/election ops ONLY — disjoint from the
+        # value pool by construction. The device-plane edge replica
+        # (models/session_client.py::_EdgeValueCache) observes only the
+        # sessioned chunks of a flush, so this path bypassing its
+        # observe pass is sound exactly as long as that disjointness
+        # holds; a cleanup op that mutated a value register would make
+        # cached causal reads stale past the TTL-less device cache's
+        # contract (docs/EDGE_READS.md "The device plane").
         if self._groups.config.monotone_tag_accept:
             self.pending_cleanup.append((group, opcode, sid))
         else:

@@ -139,7 +139,12 @@ class RegisterResponse(Response):
 class KeepAliveRequest(Message):
     # command_seq: highest command sequence the client has a response for.
     # event_index: highest event index the client has processed.
-    _fields = ("session_id", "command_seq", "event_index")
+    # unsubscribe (optional trailing, omitted when None): instance ids
+    # whose edge subscriptions (docs/EDGE_READS.md) the client dropped
+    # (LRU eviction) — the serving member retires them from its
+    # subscriber registry. Member-local, never replicated.
+    _fields = ("session_id", "command_seq", "event_index", "unsubscribe")
+    _optional = 1
 
 
 @serialize_with(203)
@@ -175,12 +180,24 @@ class CommandResponse(Response):
 @serialize_with(208)
 class QueryRequest(Message):
     # index: client's high-water commit index for SEQUENTIAL/CAUSAL reads.
-    _fields = ("session_id", "index", "operation", "consistency")
+    # subscribe (optional trailing, omitted when None): truthy asks the
+    # serving member to register this session as an edge-delta
+    # subscriber for the resources the read touches and seed the reply's
+    # ``edge`` field (docs/EDGE_READS.md); unsubscribed planes stay
+    # byte-identical.
+    _fields = ("session_id", "index", "operation", "consistency",
+               "subscribe")
+    _optional = 1
 
 
 @serialize_with(209)
 class QueryResponse(Response):
-    _fields = ("error", "error_detail", "leader", "index", "result")
+    # edge (optional trailing, omitted when None): edge replica seeds
+    # ``[(instance_id, version, state), ...]`` answering a subscribing
+    # read (docs/EDGE_READS.md).
+    _fields = ("error", "error_detail", "leader", "index", "result",
+               "edge")
+    _optional = 1
 
 
 @serialize_with(224)
@@ -207,17 +224,23 @@ class QueryBatchRequest(Message):
     """Micro-batched reads of ONE consistency level: the server performs
     the consistency gate (leadership confirmation / applied-index wait)
     once for the whole batch — for LINEARIZABLE reads that amortizes a
-    quorum round over N queries. ``operations`` positional."""
+    quorum round over N queries. ``operations`` positional.
+    ``subscribe`` as on QueryRequest (optional trailing)."""
 
-    _fields = ("session_id", "index", "consistency", "operations")
+    _fields = ("session_id", "index", "consistency", "operations",
+               "subscribe")
+    _optional = 1
 
 
 @serialize_with(227)
 class QueryBatchResponse(Response):
     """``entries`` positional with the request: [(result, error_code,
-    error_detail), ...]."""
+    error_detail), ...]. ``edge`` as on QueryResponse (optional
+    trailing)."""
 
-    _fields = ("error", "error_detail", "leader", "index", "entries")
+    _fields = ("error", "error_detail", "leader", "index", "entries",
+               "edge")
+    _optional = 1
 
 
 @serialize_with(210)
@@ -236,11 +259,20 @@ class PublishRequest(Message):
     ``trace`` (optional trailing, omitted when None): the trace id of
     the applied command whose events this push delivers, so the client
     records a ``client.event`` span on the same causal timeline.
+
+    ``deltas`` (optional trailing, omitted when None): edge state
+    deltas ``[(instance_id, version, state), ...]`` for resources this
+    session subscribed to (docs/EDGE_READS.md). Deltas are join-
+    semilattice merges client-side (max version wins), so they need no
+    position in the event channel's gap/replay machinery: a delta-only
+    push carries ``event_index=None`` and the client acks its current
+    position untouched. ``state=None`` retires the replica entry (the
+    resource was deleted or stopped being edge-servable).
     """
 
     _fields = ("session_id", "event_index", "prev_event_index", "events",
-               "group", "trace")
-    _optional = 1
+               "group", "trace", "deltas")
+    _optional = 2
 
 
 @serialize_with(211)

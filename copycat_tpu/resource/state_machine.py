@@ -79,5 +79,16 @@ class ResourceStateMachine(StateMachine):
         finally:
             commit.clean()
 
+    def edge_state(self) -> Any:
+        """Full replicated state for the edge read tier
+        (docs/EDGE_READS.md), as a ``(tag, payload)`` pair the client's
+        type-agnostic evaluators understand (``"val"``/``"map"``/
+        ``"set"``). Tagged states versioned by the applied log index
+        form a join-semilattice (merge = max version), which is what
+        makes the client replica safe under duplicated/reordered/
+        re-delivered delta delivery. ``NotImplemented`` (the default)
+        means this machine's reads are never edge-servable."""
+        return NotImplemented
+
     def delete(self) -> None:
         """Release all replicated state (subclass hook)."""

@@ -134,6 +134,11 @@ class RaftServer(Managed):
         # path bit-identically (single-group servers then register no
         # ProxyRequest handler at all).
         self._ingress_tier = knobs.get_bool("COPYCAT_INGRESS_TIER")
+        # Edge read tier (docs/EDGE_READS.md): `0` keeps the subscriber
+        # registry empty — no seeds, no deltas, the server-read plane
+        # bit-identically (the A/B discipline's knob, shared with the
+        # client side so one env var flips the whole plane)
+        self._edge_enabled = knobs.get_bool("COPYCAT_EDGE_READS")
         self._snap_serializer = Serializer()
         self._fsync_on_commit = (
             self.storage.fsync == "commit"
@@ -804,6 +809,11 @@ class RaftServer(Managed):
         # lag the register apply — each group's LEADER is authoritative
         # (the group-0 proxy outcome decides UNKNOWN_SESSION below)
         self._touch_session(sid, connection, time.monotonic())
+        if getattr(request, "unsubscribe", None):
+            # member-local edge bookkeeping (docs/EDGE_READS.md): evicted
+            # instances retire from whichever group's registry holds them
+            for grp in self.groups:
+                grp.edge_unsubscribe(sid, request.unsubscribe)
         ev = request.event_index
         seq = request.command_seq or 0
 
@@ -953,6 +963,22 @@ class RaftServer(Managed):
         return await grp.serve_query(session_id, ci, consistency,
                                      operations)
 
+    def _ms_edge_seed(self, request: Any, g: int,
+                      operations: list, served_index: int) -> list | None:
+        """Multi-group edge registration (docs/EDGE_READS.md): the
+        ingress (this member) holds the session's connection AND
+        applies every group's log, so it both registers and pushes.
+        Seeds ride group-LOCAL versions — instance ids are self-routing
+        (``iid % groups``), so the client recovers the group."""
+        if not getattr(request, "subscribe", None):
+            return None
+        consistency = QueryConsistency(request.consistency or "linearizable")
+        if consistency in (QueryConsistency.LINEARIZABLE,
+                           QueryConsistency.BOUNDED_LINEARIZABLE):
+            return None  # linearizable levels never serve from the edge
+        return self.groups[g].edge_register(
+            request.session_id, operations, served_index)
+
     async def _ms_query(self, request: msg.QueryRequest
                         ) -> msg.QueryResponse:
         consistency = QueryConsistency(request.consistency or "linearizable")
@@ -972,7 +998,12 @@ class RaftServer(Managed):
         if code:
             return msg.QueryResponse(error=code, error_detail=detail,
                                      index=tagged)
-        return msg.QueryResponse(index=tagged, result=result)
+        response = msg.QueryResponse(index=tagged, result=result)
+        seeds = self._ms_edge_seed(request, g, [request.operation],
+                                   served_index)
+        if seeds:
+            response.edge = seeds
+        return response
 
     async def _ms_query_batch(self, request: msg.QueryBatchRequest
                               ) -> msg.QueryBatchResponse:
@@ -987,6 +1018,7 @@ class RaftServer(Managed):
             for g, sub in buckets.items()))
         entries: list = [None] * len(operations)
         index: dict[int, int] = {}
+        edge: list = []
         for (g, sub), (served_index, served, err) in zip(buckets.items(),
                                                          outs):
             if err is not None:
@@ -999,7 +1031,14 @@ class RaftServer(Managed):
                 index[g] = served_index
             for (pos, _op), entry in zip(sub, served):
                 entries[pos] = tuple(entry)
-        return msg.QueryBatchResponse(index=index, entries=entries)
+            seeds = self._ms_edge_seed(request, g, [op for _, op in sub],
+                                       served_index)
+            if seeds:
+                edge.extend(seeds)
+        response = msg.QueryBatchResponse(index=index, entries=entries)
+        if edge:
+            response.edge = edge
+        return response
 
     # ------------------------------------------------------------------
     # cross-group apply fusion (docs/SHARDING.md "Apply ordering")

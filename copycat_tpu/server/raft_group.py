@@ -67,6 +67,11 @@ from .state_machine import Commit, StateMachine, StateMachineExecutor
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 
+#: edge delta record state marking a version-refresh (the resource is
+#: unchanged at the record's version — docs/EDGE_READS.md); the client
+#: bumps the entry's version/TTL without touching its state
+_EDGE_REFRESH = ("r", None)
+
 logger = logging.getLogger(__name__)
 
 
@@ -285,6 +290,25 @@ class RaftGroup:
         self._read_windows: dict[str, list] = {}
         self._read_flush_scheduled = False
 
+        # Edge read tier (docs/EDGE_READS.md): member-local subscriber
+        # registry next to the event channels — resource id -> {session
+        # id -> subscribed instance ids} plus the per-session reverse
+        # map for death cleanup. NEVER replicated: only the member
+        # holding a session's connection registers (it is the one that
+        # can push), and a lost registry (failover, restart) degrades to
+        # the client's staleness-gate re-seed, not to a wrong read.
+        self._edge_subs: dict[int, dict[int, set[int]]] = {}
+        self._edge_sessions: dict[int, set[int]] = {}
+        self._edge_dirty: dict[int, int | None] = {}  # rid -> trace|None
+        self._edge_flush_scheduled = False
+        self._edge_pushes: set[asyncio.Task] = set()
+        # delta-publication coalescing: a hot write stream batches this
+        # long per flush, so fan-out cost is pushes-per-interval per
+        # subscriber, not per commit (state-based merge makes the
+        # coalescing free — subscribers converge on the latest state)
+        self._edge_flush_s = max(
+            0.0, knobs.get_float("COPYCAT_EDGE_FLUSH_MS")) / 1e3
+
         # Per-group metric objects on this group's registry (the SERVER
         # registry itself when single-group, so names/values are
         # bit-identical; a private registry merged under a group= label
@@ -341,6 +365,15 @@ class RaftGroup:
         self._m_snap_restore_ms = m.histogram("snap.restore_ms")
         self._m_snap_meta_fallback = m.counter("snap.meta_fallbacks")
         self._m_snap_capture_fail = m.counter("snap.capture_failures")
+        # Edge read tier (docs/EDGE_READS.md): subscription registry +
+        # delta publication accounting. Pre-created so the family is
+        # present (count 0) in every snapshot the CI asserts.
+        self._m_edge_subs = m.gauge("edge.subscriptions")
+        self._m_edge_subscribes = m.counter("edge.subscribes")
+        self._m_edge_unsubscribes = m.counter("edge.unsubscribes")
+        self._m_edge_deltas = m.counter("edge.deltas_sent")
+        self._m_edge_flushes = m.counter("edge.delta_flushes")
+        self._m_edge_retired = m.counter("edge.entries_retired")
         # Per-phase commit-latency attribution (docs/OBSERVABILITY.md
         # "Cluster-wide causal tracing"): fed ONLY by traced requests —
         # the client's trace flag is the sampling switch, so the
@@ -503,6 +536,12 @@ class RaftGroup:
         self._cancel_timers()
         self._stop_replication()
         self._trace_clear()
+        for task in list(self._edge_pushes):
+            task.cancel()
+        self._edge_pushes.clear()
+        self._edge_subs.clear()
+        self._edge_sessions.clear()
+        self._edge_dirty.clear()
         for fut in self._commit_futures.values():
             if not fut.done():
                 fut.set_exception(
@@ -1823,6 +1862,10 @@ class RaftGroup:
                                          members=self.members)
         session.connection = connection
         session.last_contact = time.monotonic()
+        if getattr(request, "unsubscribe", None):
+            # member-local edge bookkeeping (docs/EDGE_READS.md): the
+            # client's LRU evictions ride the keep-alive, never the log
+            self.edge_unsubscribe(request.session_id, request.unsubscribe)
         t0 = time.perf_counter()
         try:
             await self._append_and_wait(KeepAliveEntry(
@@ -2355,11 +2398,27 @@ class RaftGroup:
         self.server.flush_fused()
         return None
 
+    def _edge_seed_response(self, request: Any, response: Any,
+                            operations: list) -> Any:
+        """Answer a subscribing read (``request.subscribe``, the
+        optional trailing field — docs/EDGE_READS.md): register the
+        session's edge subscriptions and stamp the seed records onto
+        the response's ``edge`` field. A no-op on refusals and on the
+        unsubscribed plane (the response stays byte-identical)."""
+        if getattr(request, "subscribe", None) and response.ok:
+            seeds = self.edge_register(request.session_id, operations,
+                                       response.index or 0)
+            if seeds:
+                response.edge = seeds
+        return response
+
     async def _on_query(self, request: msg.QueryRequest) -> msg.QueryResponse:
         consistency = QueryConsistency(request.consistency or "linearizable")
         self._m_query_level[consistency.value].inc()
         if not self._read_pump:
-            return await self._query_direct(request, consistency)
+            return self._edge_seed_response(
+                request, await self._query_direct(request, consistency),
+                [request.operation])
         self._m_query_ops.inc()
         fut = self._stage_read(consistency, request.session_id,
                                request.index or 0, request.operation)
@@ -2371,7 +2430,9 @@ class RaftGroup:
                                      error_detail=detail, index=index)
         if code:
             return msg.QueryResponse(error=code, error_detail=detail)
-        return msg.QueryResponse(index=index, result=result)
+        return self._edge_seed_response(
+            request, msg.QueryResponse(index=index, result=result),
+            [request.operation])
 
     async def _query_direct(self, request: msg.QueryRequest,
                             consistency: QueryConsistency
@@ -2411,7 +2472,10 @@ class RaftGroup:
         operations = request.operations or []
         self._m_query_level[consistency.value].inc(len(operations))
         if not self._read_pump or not operations:
-            return await self._query_batch_direct(request, consistency)
+            return self._edge_seed_response(
+                request,
+                await self._query_batch_direct(request, consistency),
+                operations)
         self._m_query_ops.inc(len(operations))
         idx = request.index or 0
         futs = [self._stage_read(consistency, request.session_id, idx, op)
@@ -2432,7 +2496,9 @@ class RaftGroup:
             else:
                 entries.append((result, None, None))
             index = max(index, served_index)
-        return msg.QueryBatchResponse(index=index, entries=entries)
+        return self._edge_seed_response(
+            request, msg.QueryBatchResponse(index=index, entries=entries),
+            operations)
 
     async def _query_batch_direct(self, request: msg.QueryBatchRequest,
                                   consistency: QueryConsistency
@@ -2893,10 +2959,12 @@ class RaftGroup:
         marks = self._trace_entry_marks
         for k, (clock, entry, session, machine, instance, inner, spec) in \
                 enumerate(run):
-            if marks:
-                # vector-lane entries never publish session events, so
-                # the mark is only consumed for leak hygiene here
-                marks.pop(entry.index, None)
+            trace = marks.pop(entry.index, None) if marks else None
+            if self._edge_subs:
+                # the vector lane mutates device resources too: dirty
+                # them for the turn's edge-delta flush (which flushes
+                # the fused collector before serializing states)
+                self._edge_note_apply(entry, trace)
             if pump_error is not None:
                 result, error = None, pump_error
                 log.clean(entry.index)
@@ -2992,6 +3060,8 @@ class RaftGroup:
         if fut is not None and not fut.done():
             fut.set_result((entry.index, result, error))
         if isinstance(entry, CommandEntry):
+            if self._edge_subs:
+                self._edge_note_apply(entry, trace)
             self._complete_command(entry, result, error, pushes)
 
     def _seal_and_push(self, touched,
@@ -3060,6 +3130,8 @@ class RaftGroup:
         fut = self._commit_futures.pop(entry.index, None)
         if fut is not None and not fut.done():
             fut.set_result((entry.index, result, error))
+        if self._edge_subs:
+            self._edge_note_apply(entry, ctx.trace)
         self._complete_command(entry, result, error, pushes)
 
     def _session_touched(self, session: ServerSession) -> None:
@@ -3107,6 +3179,8 @@ class RaftGroup:
     def _apply_unregister(self, entry: UnregisterEntry) -> None:
         session = self.sessions.pop(entry.session_id, None)
         self._expiring_sessions.discard(entry.session_id)
+        if self._edge_sessions:
+            self._edge_drop_session(entry.session_id)
         if not self.server.single and self.group_id == 0:
             # the metadata group's unregister retires the server-level
             # connection binding (the late-bind map would otherwise pin
@@ -3290,6 +3364,233 @@ class RaftGroup:
                 self._trace_span(trace, "event.push", t0,
                                  time.perf_counter(),
                                  self._m_lat_event_push)
+
+    # ------------------------------------------------------------------
+    # edge read tier: subscriber registry + delta publication
+    # (docs/EDGE_READS.md — deltas ride the same PublishRequest plane as
+    # the event channels above, pushed by the same connection holder,
+    # but need NO position in the gap/replay machinery: the client's
+    # join-semilattice merge makes duplicated/reordered/re-delivered
+    # deltas converge instead of corrupting)
+    # ------------------------------------------------------------------
+
+    def edge_register(self, session_id: int, operations: list,
+                      version: int) -> list | None:
+        """Register edge subscriptions for a subscribing read served at
+        (group-local) ``version`` and build the response's seed records
+        ``[(instance_id, version, state), ...]``; ``None`` when this
+        member cannot feed deltas (edge tier off, no live session
+        connection here, nothing edge-eligible in ``operations``)."""
+        if not self.server._edge_enabled:
+            return None
+        session = self.sessions.get(session_id)
+        if session is None or session.connection is None \
+                or session.connection.closed:
+            return None
+        locate = getattr(self.state_machine, "edge_locate", None)
+        state_of = getattr(self.state_machine, "edge_state_of", None)
+        if locate is None or state_of is None:
+            return None
+        seeds: list = []
+        for op in operations:
+            loc = locate(op)
+            if loc is None:
+                continue
+            rid, iid = loc
+            try:
+                state = state_of(rid)
+            except Exception:  # noqa: BLE001 — a seed must never fail a read
+                logger.exception("edge seed for resource %d failed", rid)
+                continue
+            if state is NotImplemented or state is None:
+                continue
+            self._edge_subs.setdefault(rid, {}).setdefault(
+                session_id, set()).add(iid)
+            self._edge_sessions.setdefault(session_id, set()).add(rid)
+            self._m_edge_subscribes.inc()
+            seeds.append((iid, version, state))
+        if seeds:
+            self._refresh_edge_gauge()
+        return seeds or None
+
+    def edge_unsubscribe(self, session_id: int, instance_ids) -> None:
+        """Retire a client's evicted instances (the keep-alive's
+        ``unsubscribe`` field) from the registry."""
+        rids = self._edge_sessions.get(session_id)
+        if not rids:
+            return
+        drop = set(instance_ids)
+        removed = 0
+        for rid in list(rids):
+            subs = self._edge_subs.get(rid)
+            iids = subs.get(session_id) if subs else None
+            if not iids:
+                continue
+            n = len(iids)
+            iids -= drop
+            removed += n - len(iids)
+            if not iids:
+                subs.pop(session_id, None)
+                rids.discard(rid)
+                if not subs:
+                    self._edge_subs.pop(rid, None)
+        if not rids:
+            self._edge_sessions.pop(session_id, None)
+        if removed:
+            self._m_edge_unsubscribes.inc(removed)
+            self._refresh_edge_gauge()
+
+    def _edge_drop_session(self, session_id: int) -> None:
+        """Session death (close/expiry apply) retires every
+        subscription it held."""
+        rids = self._edge_sessions.pop(session_id, None)
+        if not rids:
+            return
+        for rid in rids:
+            subs = self._edge_subs.get(rid)
+            if subs is not None:
+                subs.pop(session_id, None)
+                if not subs:
+                    self._edge_subs.pop(rid, None)
+        self._refresh_edge_gauge()
+
+    def _refresh_edge_gauge(self) -> None:
+        self._m_edge_subs.set(sum(
+            len(iids) for subs in self._edge_subs.values()
+            for iids in subs.values()))
+
+    def _edge_note_apply(self, entry: "CommandEntry",
+                         trace: int | None = None) -> None:
+        """Mark the resource a just-applied command mutated dirty for
+        this turn's delta flush. The empty-registry truthiness check at
+        every call site is the whole cost when nothing subscribed (and
+        with COPYCAT_EDGE_READS=0 nothing ever registers)."""
+        key_fn = getattr(self.state_machine, "apply_key", None)
+        rid = key_fn(entry.operation) if key_fn is not None else None
+        if rid is None:
+            # unclassifiable footprint (catalog create/get/delete may
+            # reshape any resource): conservatively dirty every
+            # subscribed resource — the flush re-reads their states and
+            # retires the ones that are gone
+            for r in self._edge_subs:
+                self._edge_dirty.setdefault(r, trace)
+        elif rid in self._edge_subs:
+            self._edge_dirty[rid] = trace
+        if not self._edge_dirty or self._edge_flush_scheduled:
+            return
+        self._edge_flush_scheduled = True
+        try:
+            loop = asyncio.get_running_loop()
+            if self._edge_flush_s > 0:
+                loop.call_later(self._edge_flush_s, self._edge_flush)
+            else:
+                loop.call_soon(self._edge_flush)
+        except RuntimeError:
+            # synchronous replay harness: no loop, nothing to push to
+            self._edge_flush_scheduled = False
+            self._edge_dirty.clear()
+
+    def _edge_flush(self) -> None:
+        """End-of-turn delta publication: serialize each dirty
+        resource's post-apply state ONCE and push it to every local
+        subscriber. A hot resource written many times in one turn
+        coalesces to one delta; versions stamp the group's
+        ``last_applied``, so a merging replica may serve any read its
+        per-group index admits up to that point (the state of a
+        resource at ``last_applied`` IS its state after its own last
+        write — later entries in the turn touched other resources)."""
+        self._edge_flush_scheduled = False
+        if not self._edge_dirty or self._closing:
+            self._edge_dirty.clear()
+            return
+        state_of = getattr(self.state_machine, "edge_state_of", None)
+        if state_of is None:
+            return
+        # staged-but-undispatched fused vector rows are device effects
+        # the serialized states must include — and their finalization
+        # dirties MORE resources, so the collector must drain BEFORE
+        # the dirty set is snapshotted: a fused write landing after the
+        # swap would be certified "unchanged" by this flush's refresh
+        # records at a version covering it (free no-op when empty)
+        self.server.flush_fused()
+        dirty, self._edge_dirty = self._edge_dirty, {}
+        version = self.last_applied
+        # one push carries ONE trace (the first dirty entry's) — the
+        # replication-window sampling limitation, documented there
+        trace = next((t for t in dirty.values() if t is not None), None)
+        pushes: dict[int, list] = {}
+        sessions: dict[int, ServerSession] = {}
+        for rid in dirty:
+            subs = self._edge_subs.get(rid)
+            if not subs:
+                continue
+            try:
+                state = state_of(rid)
+            except Exception:  # noqa: BLE001 — publication must not wound apply
+                logger.exception("edge state for resource %d failed", rid)
+                state = None
+            if state is NotImplemented:
+                state = None
+            for sid, iids in list(subs.items()):
+                session = self.sessions.get(sid)
+                if session is None:
+                    continue
+                pushes.setdefault(sid, []).extend(
+                    (iid, version, state) for iid in iids)
+                sessions[sid] = session
+            if state is None:
+                # resource gone (deleted / stopped being edge-servable):
+                # the None deltas above retire the client entries; drop
+                # the registry side too
+                self._m_edge_retired.inc()
+                for sid in list(subs):
+                    self.edge_unsubscribe(sid, list(subs.get(sid, ())))
+        if not pushes:
+            return
+        self._m_edge_flushes.inc()
+        for sid, recs in pushes.items():
+            session = sessions[sid]
+            conn = session.connection
+            if conn is None or conn.closed:
+                # cannot certify delivery for this session any more:
+                # retire its subscriptions in this group — a re-bound
+                # connection resuming pushes after a gap would certify
+                # currency over deltas the gap swallowed (the client
+                # TTLs out and re-seeds instead)
+                self._edge_drop_session(sid)
+                continue
+            # version-refresh records for the session's OTHER subscribed
+            # resources: this flush touched none of them, so their last
+            # certified state is still current at `version` — the
+            # explicit per-resource currency certification the client's
+            # monotone gate consumes (docs/EDGE_READS.md). Without it a
+            # client whose read floor rose (any server read) would
+            # stale-reject every warm entry forever.
+            dirty_iids = {iid for iid, _, _ in recs}
+            for rid in self._edge_sessions.get(sid, ()):
+                if rid in dirty:
+                    continue
+                for iid in self._edge_subs.get(rid, {}).get(sid, ()):
+                    if iid not in dirty_iids:
+                        recs.append((iid, version, _EDGE_REFRESH))
+            self._m_edge_deltas.inc(len(recs))
+            task = spawn(self._edge_push(conn, session, recs, trace),
+                         name="edge-push")
+            self._edge_pushes.add(task)
+            task.add_done_callback(self._edge_pushes.discard)
+
+    async def _edge_push(self, conn: Connection, session: ServerSession,
+                         recs: list, trace: int | None) -> None:
+        try:
+            await asyncio.wait_for(conn.send(msg.PublishRequest(
+                session_id=session.id, event_index=None,
+                prev_event_index=None, events=None,
+                group=self.wire_group, trace=trace, deltas=recs)), 1.0)
+        except (TransportError, OSError, asyncio.TimeoutError):
+            # delivery unknown: stop certifying for this session — its
+            # replica TTLs out and re-seeds; resumed pushes over a
+            # possibly-lossy gap could otherwise certify stale state
+            self._edge_drop_session(session.id)
 
     # ------------------------------------------------------------------
     # observability

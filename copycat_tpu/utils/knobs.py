@@ -213,6 +213,24 @@ _knob("COPYCAT_BLACKBOX_BYTES", "int", 262144,
 _knob("COPYCAT_CLIENT_FOLLOWER_READS", "bool", True,
       "`0` pins sub-linearizable reads back to the leader connection",
       section="client")
+_knob("COPYCAT_EDGE_READS", "bool", True,
+      "`0` removes the edge read tier (client-local CRDT replicas "
+      "serving CAUSAL/SEQUENTIAL reads; docs/EDGE_READS.md) — every "
+      "read pays the server round-trip, bit-identically to the "
+      "pre-edge plane", section="client")
+_knob("COPYCAT_EDGE_MAX_RESOURCES", "int", 1024,
+      "client-side edge replica cap (LRU eviction back to server "
+      "reads; evicted instances unsubscribe via the next keep-alive)",
+      section="client")
+_knob("COPYCAT_EDGE_TTL_S", "float", 5.0,
+      "edge staleness gate: a replica entry older than this (no delta, "
+      "no re-seed) stops serving locally and the next read re-seeds "
+      "from the server", section="client")
+_knob("COPYCAT_EDGE_FLUSH_MS", "float", 10.0,
+      "server-side delta-publication coalescing interval: dirty "
+      "resources batch for this long before one push per subscriber "
+      "(state-based merge makes coalescing free); `0` flushes every "
+      "event-loop turn", section="client")
 
 # --- platform --------------------------------------------------------------
 _knob("COPYCAT_COMPILE_CACHE", "raw", None,
@@ -239,7 +257,7 @@ _knob("COPYCAT_VERDICT_DEVICE_TIMEOUT", "float", 120.0,
 _knob("COPYCAT_BENCH_SCENARIO", "str", "counter",
       "scenario: `counter`/`election`/`map`/`map_read`/`lock`/`mixed`/"
       "`host`/`host_read`/`session`/`spi`/`readmix`/`cluster`/`sharded`/"
-      "`apply`/`recovery`/`compartment`",
+      "`apply`/`recovery`/`compartment`/`fanout`",
       section="bench")
 _knob("COPYCAT_BENCH_GROUPS", "int", None,
       default_doc="10000 (election: 1000)",
@@ -422,6 +440,22 @@ _knob("COPYCAT_BENCH_COMPARTMENT_STORAGE", "str", "disk",
 _knob("COPYCAT_BENCH_COMPARTMENT_NEMESIS", "bool", True,
       "`0` skips the process-level nemesis phase (kill -9 a member + "
       "an ingress proxy mid-load, zero lost acknowledged writes)",
+      section="bench")
+_knob("COPYCAT_BENCH_FANOUT_READERS", "str", "8,32,128",
+      "comma-separated reader-session counts the fanout scenario "
+      "sweeps", section="bench")
+_knob("COPYCAT_BENCH_FANOUT_WRITERS", "int", 2,
+      "writer sessions in the fanout scenario", section="bench")
+_knob("COPYCAT_BENCH_FANOUT_KEYS", "int", 16,
+      "counter resources the fanout scenario reads/writes",
+      section="bench")
+_knob("COPYCAT_BENCH_FANOUT_READS", "int", 50,
+      "reads per reader session per burst in the fanout scenario",
+      section="bench")
+_knob("COPYCAT_BENCH_FANOUT_BURSTS", "int", 3,
+      "measured bursts (best-of) per reader count", section="bench")
+_knob("COPYCAT_BENCH_FANOUT_ZIPF", "float", 0.9,
+      "zipf skew exponent for the fanout scenario's key draw",
       section="bench")
 _knob("COPYCAT_BENCH_NO_CPU_FALLBACK", "bool", False,
       "`1` makes an unreachable accelerator FATAL instead of a degraded "

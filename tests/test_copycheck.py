@@ -758,6 +758,29 @@ def test_span_pairing_live_tree_names_all_in_vocabulary():
     assert [f for f in result.findings if f.rule == "span-pairing"] == []
 
 
+def test_span_pairing_admits_edge_spans_and_still_fires_uncataloged():
+    """The edge read tier's span names (docs/EDGE_READS.md) are in the
+    REAL vocabulary table, and the rule still fires on an uncataloged
+    edge-adjacent name — the seeded-violation proof that adding rows
+    did not blunt the gate."""
+    catalog = parse_span_catalog(
+        open(os.path.join(REPO, "docs", "OBSERVABILITY.md")).read())
+    assert {"client.edge_serve", "client.delta"} <= catalog
+    tree = _tree("""
+        class EdgeReadTier:
+            def ok(self, tracer, trace, t0, t1):
+                tracer.span(trace, "client.edge_serve", t0, t1)
+                tracer.span(trace, "client.delta", t0, t1)
+
+            def bad(self, tracer, trace, t0, t1):
+                tracer.span(trace, "client.edge_servee", t0, t1)
+    """)
+    found = check_span_contract(tree, "copycat_tpu/client/edge.py",
+                                catalog)
+    assert len(found) == 1
+    assert "client.edge_servee" in found[0].message
+
+
 # ---------------------------------------------------------------------------
 # exit-code
 # ---------------------------------------------------------------------------

@@ -497,6 +497,123 @@ def test_manager_tpu_snapshot_restores_device_values(tmp_path, monkeypatch):
     asyncio.run(run())
 
 
+def test_manager_tpu_snapshot_restores_device_map_and_set(tmp_path,
+                                                          monkeypatch):
+    """The device map and set machines' ``snapshot_state``/
+    ``restore_state`` hooks (docs/DURABILITY.md): a manager hosting them
+    no longer opts the whole server into replay-only recovery. The
+    differential: a server that crashed after the snapshot serves the
+    SAME answers as the never-crashed one for device-resident int
+    entries, host-shadowed string entries, sizes and membership — and
+    it provably restored from the image (``last_applied`` at or past
+    the snapshot index before any replay)."""
+    monkeypatch.setenv("COPYCAT_SNAPSHOTS", "1")
+    monkeypatch.setenv("COPYCAT_SNAPSHOT_ENTRIES", "8")
+    monkeypatch.setenv("COPYCAT_SNAPSHOT_RETAIN", "0")
+    from copycat_tpu.collections import DistributedMap, DistributedSet
+    from copycat_tpu.io.local import LocalServerRegistry
+    from copycat_tpu.manager.atomix import AtomixClient, AtomixServer
+    from copycat_tpu.manager.device_executor import DeviceEngineConfig
+
+    from raft_fixtures import next_ports
+
+    d = tmp_path / "m0"
+
+    async def probe(client) -> dict:
+        m = await client.get("m", DistributedMap)
+        s = await client.get("s", DistributedSet)
+        return {
+            "dev_keys": [await m.get(k) for k in range(1, 7)],
+            "shadow": await m.get("name"),
+            "absent": await m.get(99),
+            "m_size": await m.size(),
+            "s_members": [await s.contains(v) for v in (5, 6, 7, "x")],
+            "s_size": await s.size(),
+        }
+
+    async def run() -> None:
+        registry = LocalServerRegistry()
+        (addr,) = next_ports(1)
+
+        def build_server() -> AtomixServer:
+            return AtomixServer(
+                addr, [addr], LocalTransport(registry, local_address=addr),
+                storage=_storage(StorageLevel.DISK, d),
+                election_timeout=0.2, heartbeat_interval=0.04,
+                session_timeout=10.0, executor="tpu",
+                engine_config=DeviceEngineConfig(capacity=4))
+
+        server = build_server()
+        await server.open()
+        client = AtomixClient([addr], LocalTransport(registry),
+                              session_timeout=10.0)
+        await client.open()
+        try:
+            m = await client.get("m", DistributedMap)
+            s = await client.get("s", DistributedSet)
+            for k in range(1, 7):
+                await m.put(k, k * 10)          # device probe table
+            await m.put("name", "shadowed")     # host shadow
+            await m.remove(3)
+            for v in (5, 6, 7):
+                await s.add(v)                  # device probe table
+            await s.add("x")                    # host shadow
+            await s.remove(6)
+            raft = server.server
+            assert raft._snap_index > 0, \
+                "map/set hooks must not opt the manager out of snapshots"
+            before = await probe(client)
+            await client.close()
+            await crash_server(raft)
+
+            reborn = build_server()
+            assert reborn.server.last_applied >= raft._snap_index
+            await reborn.open()
+            client2 = AtomixClient([addr], LocalTransport(registry),
+                                   session_timeout=10.0)
+            await client2.open()
+            try:
+                assert await probe(client2) == before
+                # the restored machines keep working (device + shadow)
+                m2 = await client2.get("m", DistributedMap)
+                assert await m2.put(1, 11) == 10
+                assert await m2.get(1) == 11
+                s2 = await client2.get("s", DistributedSet)
+                assert await s2.add(7) is False  # still a member
+            finally:
+                await client2.close()
+            await reborn.close()
+        finally:
+            try:
+                await server.close()
+            except Exception:
+                pass
+
+    asyncio.run(run())
+
+
+def test_device_map_set_ttl_still_opts_out(monkeypatch):
+    """An armed per-key TTL timer holds commit references that cannot
+    round-trip a snapshot: the map/set machines must keep opting out
+    (NotImplemented) exactly like the value machine's documented rule."""
+    from copycat_tpu.manager.device_executor import (
+        DeviceMapState,
+        DeviceSetState,
+        _Held,
+    )
+    from copycat_tpu.server.state_machine import Commit
+
+    for cls in (DeviceMapState, DeviceSetState):
+        machine = cls.__new__(cls)  # no engine needed for the hook
+        machine._held = {}
+        assert machine.snapshot_state() == {"held": []}
+        held = _Held(Commit(0, None, 0.0, None, None), value=1)
+        machine._held[1] = held
+        assert machine.snapshot_state() is not NotImplemented
+        held.timer = object()  # armed TTL
+        assert machine.snapshot_state() is NotImplemented
+
+
 # ---------------------------------------------------------------------------
 # snapshot store + log prefix units
 # ---------------------------------------------------------------------------

@@ -88,6 +88,103 @@ def test_queries_and_atomic_reads(client):
         s.query_batch([2], ap.OP_VALUE_GET, consistency="nope")
 
 
+def test_edge_cache_serves_causal_reads_locally(deep_rg, client):
+    """The device-plane edge replica (docs/EDGE_READS.md): CAUSAL-level
+    GETs of groups this client already read serve from its own
+    committed post-apply state rows — zero engine rounds — and every
+    write shape (ADD / SET / successful and failed CAS / GET_AND_SET)
+    keeps the replica in lockstep with the engine's answer."""
+    s = client.open_session()
+    g = 5
+    edge = client._edge
+    assert edge is not None
+    # cold read: drives the engine, marks interest
+    s.submit(g, ap.OP_LONG_ADD, 4)
+    client.flush()
+    assert list(s.query_batch([g], ap.OP_VALUE_GET,
+                              consistency="causal")) == [4]
+    serves0 = edge._m_serves.value
+    script = [
+        (ap.OP_LONG_ADD, 3, 0, 7),          # add -> 7
+        (ap.OP_VALUE_SET, 9, 0, 9),         # set -> 9
+        (ap.OP_VALUE_CAS, 9, 12, 12),       # cas success -> 12
+        (ap.OP_VALUE_CAS, 9, 99, 12),       # cas FAILURE -> still 12
+        (ap.OP_VALUE_GET_AND_SET, 20, 0, 20),
+    ]
+    for opcode, a, b, expect in script:
+        s.submit(g, opcode, a, b)
+        client.flush()
+        rounds_before = deep_rg.rounds
+        local = s.query_batch([g] * 3, ap.OP_VALUE_GET,
+                              consistency="causal")
+        assert list(local) == [expect] * 3, (opcode, local)
+        assert deep_rg.rounds == rounds_before, "local serve drove rounds"
+        # the engine agrees (sequential drives the query lane)
+        engine = s.query_batch([g], ap.OP_VALUE_GET,
+                               consistency="sequential")
+        assert list(engine) == [expect]
+    assert edge._m_serves.value > serves0
+    # sequential never serves from the cache
+    assert edge._m_serves.value == serves0 + 3 * len(script)
+
+
+def test_edge_cache_refuses_ttl_groups():
+    """A TTL'd SET arms a device-side deadline the host cache cannot
+    observe (the register later reads as unset) — the group becomes
+    permanently uncacheable instead of serving the value past its
+    expiry (found by review; the engine-side expiry is invisible to
+    the result-row feed)."""
+    from copycat_tpu.models.session_client import _EdgeValueCache
+    from copycat_tpu.utils.metrics import MetricsRegistry
+
+    cache = _EdgeValueCache(MetricsRegistry())
+    cache.interest.update((0, 1))
+    cache.observe(np.asarray([0, 1]),
+                  np.asarray([ap.OP_VALUE_SET, ap.OP_VALUE_SET]),
+                  np.asarray([5, 6]), np.asarray([0, 0]),
+                  np.asarray([0, 30]),  # group 1 arms a TTL
+                  np.asarray([0, 0]))
+    assert cache.serve(np.asarray([0])).tolist() == [5]
+    assert cache.serve(np.asarray([1])) is None
+    # even a later plain write to the TTL'd group stays uncached
+    cache.observe(np.asarray([1]), np.asarray([ap.OP_LONG_ADD]),
+                  np.asarray([1]), np.asarray([0]), np.asarray([0]),
+                  np.asarray([7]))
+    assert cache.serve(np.asarray([1])) is None
+
+
+def test_edge_cache_purged_on_abandoned_flush(monkeypatch):
+    """An abandoned drive leaves its ops INDETERMINATE: the replica is
+    purged so a later causal read cannot hide a write that may have
+    applied (the correlate-a-fresh-read contract)."""
+    from copycat_tpu.models.session_client import _EdgeValueCache
+    from copycat_tpu.utils.metrics import MetricsRegistry
+
+    cache = _EdgeValueCache(MetricsRegistry())
+    cache.interest.add(0)
+    cache.observe(np.asarray([0]), np.asarray([ap.OP_VALUE_SET]),
+                  np.asarray([5]), np.asarray([0]), np.asarray([0]),
+                  np.asarray([0]))
+    assert cache.serve(np.asarray([0])).tolist() == [5]
+    cache.purge()
+    assert cache.serve(np.asarray([0])) is None
+    assert cache._m_purges.value == 1
+
+
+def test_edge_cache_knob_off(monkeypatch):
+    monkeypatch.setenv("COPYCAT_EDGE_READS", "0")
+    rg = RaftGroups(4, 3, log_slots=32, submit_slots=4, seed=12,
+                    config=Config(monotone_tag_accept=True))
+    rg.wait_for_leaders()
+    c = BulkSessionClient(rg)
+    assert c._edge is None
+    s = c.open_session()
+    s.submit(0, ap.OP_LONG_ADD, 2)
+    c.flush()
+    assert list(s.query_batch([0], ap.OP_VALUE_GET,
+                              consistency="causal")) == [2]
+
+
 def test_lock_events_and_expiry_fanout(deep_rg, client):
     """A dead session's lock is released THROUGH THE LOG on a monotone
     engine (cleanup rides the next flush), and the grant event reaches

@@ -260,11 +260,14 @@ def test_drive_query_vector_refuses_writes():
 
 
 @async_test(timeout=300)
-async def test_follower_reads_round_robin():
+async def test_follower_reads_round_robin(monkeypatch):
     """SEQUENTIAL reads round-robin across the cluster (follower read
     scale-out) and still return the committed value — the server-side
     client-index wait keeps them at-or-after the client's own writes;
-    lagging servers refuse and the client falls back to the leader."""
+    lagging servers refuse and the client falls back to the leader.
+    Edge reads are pinned OFF: this test exercises the server read
+    lane the edge tier exists to bypass (docs/EDGE_READS.md)."""
+    monkeypatch.setenv("COPYCAT_EDGE_READS", "0")
     registry = LocalServerRegistry()
     addrs = next_ports(3)
     servers = [

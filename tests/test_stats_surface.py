@@ -175,9 +175,14 @@ def test_cli_stats_what_all(capsys):
     # text (names dot->underscore sanitized)
     assert '"apply.parallel_spans"' in out
     assert '"apply.fused_dispatches"' in out
+    # the edge.* family (subscriber registry + delta publication,
+    # docs/EDGE_READS.md) rides the same surfaces
+    assert '"edge.subscriptions"' in out
+    assert '"edge.deltas_sent"' in out
     assert "=== metrics ===" in out
     assert "copycat_query_windows" in out
     assert "copycat_apply_fused_dispatches" in out
+    assert "copycat_edge_subscriptions" in out
     assert "=== flight ===" in out
 
 
