@@ -198,6 +198,22 @@ def log(msg: str) -> None:
 #: stats_snapshot + the client registry), keyed by component name.
 METRICS_SNAPSHOTS: dict = {}
 
+#: retained ``/series`` windows scenarios contribute to the artifact
+#: (docs/OBSERVABILITY.md "Retrospective telemetry") — where an
+#: end-of-run snapshot says WHAT the run cost, the series says WHEN:
+#: commit-rate ramp, election spikes mid-run, latency onsets. Keyed by
+#: component name like METRICS_SNAPSHOTS; empty when the servers ran
+#: with COPYCAT_SERIES=0 or the scenario spins no server.
+SERIES_WINDOWS: dict = {}
+
+
+def capture_series(component: str, server_like: object) -> None:
+    """Stash ``server_like``'s retained series window (if it keeps one)
+    under ``component`` for the ``--metrics-json`` artifact."""
+    store = getattr(server_like, "series", None)
+    if store is not None:
+        SERIES_WINDOWS[component] = store.payload()
+
 
 def _bench_gc_tune() -> None:
     """GC tuning shared by the SPI-stack scenarios (the production-server
@@ -855,6 +871,7 @@ def run_spi() -> dict:
             # attributable snapshot (server lanes + transport + client)
             METRICS_SNAPSHOTS["server"] = server.server.stats_snapshot()
             METRICS_SNAPSHOTS["client"] = client.client.metrics.snapshot()
+            capture_series("server", server.server)
             return {
                 "metric": (f"spi_client_visible_ops_per_sec_{instances}"
                            f"_device_instances"
@@ -1351,6 +1368,7 @@ def run_cluster() -> dict:
             assert converged >= len(servers) // 2 + 1, converged
             METRICS_SNAPSHOTS["server"] = leader.stats_snapshot()
             METRICS_SNAPSHOTS["client"] = clients[0].metrics.snapshot()
+            capture_series("server", leader)
             best = max(reps)
             ack = leader.metrics.histogram("repl.ack_ms")
             raft_snap = METRICS_SNAPSHOTS["server"]["raft"]
@@ -1599,6 +1617,7 @@ def run_sharded() -> dict:
                 assert v == expected[k], (k, v, expected[k])
             METRICS_SNAPSHOTS["server"] = servers[0].stats_snapshot()
             METRICS_SNAPSHOTS["client"] = clients[0].metrics.snapshot()
+            capture_series("server", servers[0])
             best = max(reps)
             # routing mix: commands per owning group, summed over every
             # member's ingress counters
@@ -2583,20 +2602,11 @@ def _artifact_meta() -> dict:
     experiment, not a regression; the bench-baseline CI gate keys off
     this block when explaining a miss)."""
     import platform
-    import subprocess
 
-    sha = None
-    try:
-        out = subprocess.run(
-            ["git", "rev-parse", "HEAD"],
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-            capture_output=True, text=True, timeout=10)
-        if out.returncode == 0:
-            sha = out.stdout.strip()
-    except (OSError, subprocess.SubprocessError):
-        pass
+    from .utils.buildinfo import git_sha
+
     return {
-        "git_sha": sha,
+        "git_sha": git_sha(),
         "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "knobs": knobs.overrides(),
         "host": {
@@ -2696,7 +2706,10 @@ def main() -> None:
         with open(args.metrics_json, "w") as f:
             json.dump({**result, "scenario": SCENARIO,
                        "meta": _artifact_meta(),
-                       "metrics": METRICS_SNAPSHOTS}, f)
+                       "metrics": METRICS_SNAPSHOTS,
+                       # the run's retained /series windows (empty under
+                       # COPYCAT_SERIES=0) — the gate reads none of it
+                       "series": SERIES_WINDOWS}, f)
         log(f"bench: metrics snapshot written to {args.metrics_json}")
     print(json.dumps(result))
 

@@ -222,19 +222,15 @@ def _flatten_numeric(snap: dict, prefix: str = "") -> dict:
 
 
 def _series_sort_key(key: str) -> tuple:
-    """Label-aware ordering: ``name{label}`` variants sort WITH their
-    family (name first, then label set, then any histogram sub-key), not
-    after every unlabeled name — ASCII ``{`` > letters, so a plain sort
-    scattered per-group (``group=``) series away from their siblings and
-    a multi-group watch read as disjoint families instead of one metric
-    with N labeled series."""
-    brace = key.find("{")
-    if brace < 0:
-        return (key, "", "")
-    end = key.find("}", brace)
-    if end < 0:
-        return (key, "", "")
-    return (key[:brace], key[brace + 1:end], key[end + 1:])
+    """Label-aware ordering for watch/series renders — the canonical
+    implementation lives with the series plane
+    (``utils/timeseries.py::series_sort_key``): ``name{label}``
+    variants sort WITH their family, numeric label values in numeric
+    order (``group=2`` before ``group=10``), so labeled delta rendering
+    reads identically to the unlabeled path."""
+    from .utils.timeseries import series_sort_key
+
+    return series_sort_key(key)
 
 
 def _render_header(snap: dict, lines: list, prefix: str = "") -> None:
@@ -477,15 +473,10 @@ def _trace(args: argparse.Namespace) -> int:
     return 0
 
 
-async def collect_doctor(addresses: list[str], slowest: int = 3
-                         ) -> tuple[dict, list, list]:
-    """The doctor's fan-out (exposed for tests): every member's
-    ``/health`` + ``/flight`` + ``/stats`` gathered in parallel, plus
-    the slowest traces from the first reachable member. Returns
-    ``(members, failed, slowest_traces)`` where ``members`` maps each
-    REACHED address to its payloads and ``failed`` lists the
-    unreachable ones — partial fan-outs assemble an incomplete report,
-    never a dropped one."""
+def _fetch_json_fn():
+    """A ``fetch_json(address, path) -> dict | None`` closure over the
+    stats fetcher — the shared fan-out primitive of every collection
+    verb (trace/doctor/timeline/top)."""
     from .server.stats import fetch_stats
 
     async def fetch_json(address: str, path: str):
@@ -494,22 +485,56 @@ async def collect_doctor(addresses: list[str], slowest: int = 3
         except (OSError, RuntimeError, ValueError, asyncio.TimeoutError):
             return None
 
+    return fetch_json
+
+
+def _series_payload(raw: dict | None) -> dict | None:
+    """Normalize a fetched ``/series`` body: a member with the series
+    plane off answers the unknown-route error payload — that is "no
+    series retained" (the assembler marks it incomplete), not a
+    series."""
+    if isinstance(raw, dict) and "samples" in raw:
+        return raw
+    return None
+
+
+async def collect_doctor(addresses: list[str], slowest: int = 3,
+                         last_s: float | None = None
+                         ) -> tuple[dict, list, list]:
+    """The doctor's fan-out (exposed for tests): every member's
+    ``/health`` + ``/flight`` + ``/stats`` gathered in parallel, plus
+    the slowest traces from the first reachable member. With ``last_s``
+    (``doctor --last N``) each member's retained ``/series`` window
+    rides along for retrospective time-correlation. Returns
+    ``(members, failed, slowest_traces)`` where ``members`` maps each
+    REACHED address to its payloads and ``failed`` lists the
+    unreachable ones — partial fan-outs assemble an incomplete report,
+    never a dropped one."""
+    import time
+
+    fetch_json = _fetch_json_fn()
+    since = time.time() - last_s if last_s else None
+
     async def member(address: str):
-        health, flight, stats = await asyncio.gather(
-            fetch_json(address, "/health"),
-            fetch_json(address, "/flight"),
-            fetch_json(address, "/stats"))
-        return address, health, flight, stats
+        paths = ["/health", "/flight", "/stats"]
+        if last_s:
+            paths.append(f"/series?since={since}")
+        payloads = await asyncio.gather(*(fetch_json(address, p)
+                                          for p in paths))
+        return address, payloads
 
     rows = await asyncio.gather(*(member(a) for a in addresses))
     members: dict = {}
     failed: list = []
-    for address, health, flight, stats in rows:
+    for address, payloads in rows:
+        health, flight, stats = payloads[:3]
         if health is None and flight is None and stats is None:
             failed.append(address)
             continue
         members[address] = {"health": health, "flight": flight,
                             "stats": stats}
+        if last_s:
+            members[address]["series"] = _series_payload(payloads[3])
     traces: list = []
     for address in members:
         body = await fetch_json(address, "/traces")
@@ -533,7 +558,8 @@ def _doctor(args: argparse.Namespace) -> int:
     if rc:
         return rc
     members, failed, traces = asyncio.run(
-        collect_doctor(args.addresses, args.slowest))
+        collect_doctor(args.addresses, args.slowest,
+                       last_s=getattr(args, "last", None)))
     if not members:
         print(f"copycat-tpu doctor: none of {len(args.addresses)} "
               f"member(s) reachable ({', '.join(args.addresses)})\n"
@@ -547,6 +573,129 @@ def _doctor(args: argparse.Namespace) -> int:
     else:
         print(render_doctor_report(report))
     return 0
+
+
+async def collect_timeline(addresses: list[str]
+                           ) -> tuple[dict, list]:
+    """The timeline's fan-out (exposed for tests): every process's
+    ``/series`` + ``/flight`` + ``/health`` gathered in parallel.
+    Addresses answering NONE of the routes are failed; a reachable
+    process without a ``/series`` route (series plane off) stays in the
+    merge — the assembler marks the timeline incomplete, never drops
+    it."""
+    fetch_json = _fetch_json_fn()
+
+    async def member(address: str):
+        series, flight, health = await asyncio.gather(
+            fetch_json(address, "/series"),
+            fetch_json(address, "/flight"),
+            fetch_json(address, "/health"))
+        return address, series, flight, health
+
+    rows = await asyncio.gather(*(member(a) for a in addresses))
+    members: dict = {}
+    failed: list = []
+    for address, series, flight, health in rows:
+        if series is None and flight is None and health is None:
+            failed.append(address)
+            continue
+        members[address] = {"series": _series_payload(series),
+                            "flight": flight, "health": health}
+    return members, failed
+
+
+def _timeline(args: argparse.Namespace) -> int:
+    """``copycat-tpu timeline addr [addr...]``: fan out to every
+    process's stats listener and render ONE merged cluster timeline
+    (docs/OBSERVABILITY.md "Retrospective telemetry") — per-member
+    metric sparklines time-aligned on a common grid with
+    flight-recorder faults, black-box crash tails, health findings and
+    elections/restarts as event marks. Unreachable members mark the
+    timeline ``incomplete`` — partial timelines render, never drop; a
+    fully unreachable cluster is a one-line error + exit 1."""
+    from .utils.timeseries import assemble_timeline, render_timeline
+
+    rc = _bad_addresses(args.addresses)
+    if rc:
+        return rc
+    members, failed = asyncio.run(collect_timeline(args.addresses))
+    if not members:
+        print(f"copycat-tpu timeline: none of {len(args.addresses)} "
+              f"member(s) reachable ({', '.join(args.addresses)})\n"
+              f"(are the servers running with --stats-port?)",
+              file=sys.stderr)
+        return 1
+    names = ([n for n in args.names.split(",") if n]
+             if getattr(args, "names", None) else None)
+    timeline = assemble_timeline(members, failed_members=failed,
+                                 last_s=args.last, names=names)
+    if args.json:
+        print(json.dumps(timeline, indent=2))
+    else:
+        print(render_timeline(timeline))
+    return 0
+
+
+def _top(args: argparse.Namespace) -> int:
+    """``copycat-tpu top addr [addr...]``: the timeline's live sibling
+    — a cluster-wide dashboard (per-group role/term/commit rate, lane
+    mix, replication in-flight, worst health verdict) refreshed in
+    place every ``--watch`` seconds (Ctrl-C exits; ``--once`` prints a
+    single frame). Unreachable members render as rows, never drop."""
+    import time
+
+    from .utils.timeseries import render_top
+
+    rc = _bad_addresses(args.addresses)
+    if rc:
+        return rc
+    fetch_json = _fetch_json_fn()
+
+    async def collect() -> tuple[dict, list]:
+        async def member(address: str):
+            stats, health = await asyncio.gather(
+                fetch_json(address, "/stats"),
+                fetch_json(address, "/health"))
+            return address, stats, health
+
+        rows = await asyncio.gather(*(member(a) for a in args.addresses))
+        members: dict = {}
+        failed: list = []
+        for address, stats, health in rows:
+            if stats is None and health is None:
+                failed.append(address)
+                continue
+            members[address] = {"stats": stats, "health": health}
+        return members, failed
+
+    prev: dict | None = None
+    prev_t = 0.0
+    failures = 0
+    try:
+        while True:
+            members, failed = asyncio.run(collect())
+            if not members:
+                failures += 1
+                if args.once or failures >= 3:
+                    print(f"copycat-tpu top: none of "
+                          f"{len(args.addresses)} member(s) reachable "
+                          f"({', '.join(args.addresses)})",
+                          file=sys.stderr)
+                    return 1
+            else:
+                failures = 0
+                now = time.monotonic()
+                frame, state = render_top(members, failed, prev,
+                                          now - prev_t if prev else 0.0)
+                if args.once:
+                    print(frame, flush=True)
+                    return 0
+                # refresh in place: clear + home, then the new frame
+                print(f"\x1b[2J\x1b[H{frame}", flush=True)
+                prev, prev_t = state, now
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
 
 
 def _cluster(args: argparse.Namespace) -> int:
@@ -693,6 +842,50 @@ def main(argv: list[str] | None = None) -> None:
     doctor.add_argument("--json", action="store_true",
                         help="emit the report as JSON (the CI artifact "
                              "shape) instead of the rendered text")
+    doctor.add_argument("--last", type=float, default=None, metavar="N",
+                        help="retrospective mode: also pull each "
+                             "member's /series for the last N seconds "
+                             "and time-correlate retained metrics "
+                             "(commit lag, elections, latency, SLO "
+                             "burn) with the diagnosed causes")
+
+    timeline = sub.add_parser(
+        "timeline", help="merge every member's /series + /flight + "
+                         "/health into one time-aligned cluster "
+                         "timeline (sparklines + event marks)")
+    timeline.add_argument("addresses", nargs="+", metavar="host:port",
+                          help="stats endpoints to merge; unreachable "
+                               "members mark the timeline incomplete "
+                               "(never dropped)")
+    timeline.add_argument("--last", type=float, default=60.0,
+                          metavar="N",
+                          help="window: render the last N seconds "
+                               "(default 60; capped by each member's "
+                               "retention ring)")
+    timeline.add_argument("--names", default=None, metavar="P1,P2",
+                          help="comma-separated metric-name prefixes "
+                               "to render (default: commit index, "
+                               "elections, commit lag, health status, "
+                               "slo.*)")
+    timeline.add_argument("--json", action="store_true",
+                          help="emit the merged timeline as JSON (the "
+                               "CI artifact shape) instead of the "
+                               "rendered sparklines")
+
+    top = sub.add_parser(
+        "top", help="live cluster dashboard: per-member role, commit "
+                    "rate, lane mix, replication in-flight and health "
+                    "verdict, refreshed in place")
+    top.add_argument("addresses", nargs="+", metavar="host:port",
+                     help="stats endpoints to watch; unreachable "
+                          "members render as rows, never dropped")
+    top.add_argument("--watch", type=float, default=2.0, metavar="N",
+                     help="refresh every N seconds (default 2; "
+                          "Ctrl-C exits)")
+    top.add_argument("--once", action="store_true",
+                     help="print a single frame and exit (CI / "
+                          "non-tty mode; rates need two polls, so a "
+                          "single frame shows '-')")
 
     cluster = sub.add_parser(
         "cluster", help="run/operate a multi-process deployment "
@@ -758,6 +951,10 @@ def main(argv: list[str] | None = None) -> None:
         raise SystemExit(_trace(args))
     if args.verb == "doctor":
         raise SystemExit(_doctor(args))
+    if args.verb == "timeline":
+        raise SystemExit(_timeline(args))
+    if args.verb == "top":
+        raise SystemExit(_top(args))
     if args.verb == "cluster":
         raise SystemExit(_cluster(args))
     if args.verb == "serve":

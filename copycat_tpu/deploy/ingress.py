@@ -45,9 +45,12 @@ from typing import Any, Callable
 from ..io.transport import Address, Connection, Transport, TransportError
 from ..protocol import messages as msg
 from ..protocol.operations import QueryConsistency
+from ..utils import knobs
 from ..utils.managed import Managed
 from ..utils.metrics import MetricsRegistry
+from ..utils.scheduled import Scheduled, schedule_repeating
 from ..utils.tasks import spawn
+from ..utils.timeseries import SeriesStore
 from ..utils.tracing import TRACER
 
 logger = logging.getLogger(__name__)
@@ -132,6 +135,15 @@ class IngressServer(Managed):
         self._m_events = m.counter("ingress.events_relayed")
         self._m_retries = m.counter("ingress.proxy_retries")
         self._m_reroutes = m.counter("ingress.reroutes")
+        # Retrospective telemetry for the proxy tier: the ingress has
+        # no health monitor to piggyback, so its series ring runs on
+        # one tiny repeating timer (opened/cancelled with the process;
+        # skip-if-overlapping like every Scheduled). COPYCAT_SERIES=0
+        # removes store, timer and route (A/B).
+        self.series = (SeriesStore(node=address, role="ingress",
+                                   metrics=m)
+                       if knobs.get_bool("COPYCAT_SERIES") else None)
+        self._series_timer: Scheduled | None = None
         # Same names/semantics as the server-side ingress phases
         # (docs/OBSERVABILITY.md) so per-tier attribution reads one
         # vocabulary; recorded for EVERY forward on this tier (its whole
@@ -147,11 +159,18 @@ class IngressServer(Managed):
     async def _do_open(self) -> None:
         self._closing = False
         await self._server.listen(self.address, self._accept)
+        if self.series is not None:
+            self._series_timer = schedule_repeating(
+                self.series.interval_s, self.series.interval_s,
+                lambda: self.series.maybe_sample(self.metrics.snapshot))
         logger.info("%s listening at %s (fronting %s, %d group(s))",
                     self.name, self.address, self.members, self.num_groups)
 
     async def _do_close(self) -> None:
         self._closing = True
+        if self._series_timer is not None:
+            self._series_timer.cancel()
+            self._series_timer = None
         await self._server.close()
         await self._client.close()
         self._peer_connections.clear()

@@ -45,6 +45,7 @@ from ..utils import knobs
 from ..utils.managed import Managed
 from ..utils.metrics import MetricsRegistry
 from ..utils.tasks import spawn
+from ..utils.timeseries import SeriesStore
 from .topology import IngressSpec, MemberSpec, TopologySpec
 
 logger = logging.getLogger(__name__)
@@ -127,6 +128,13 @@ class Supervisor(Managed):
             "COPYCAT_DEPLOY_HEALTH_INTERVAL_S")
 
         m = self.metrics = MetricsRegistry()
+        # retrospective telemetry for the deploy plane: the supervisor's
+        # own /series (deploy.* restart/health-check rates over time),
+        # sampled inside the EXISTING health watch — no extra task.
+        # COPYCAT_SERIES=0 removes the store and the route (A/B).
+        self.series = (SeriesStore(node=self.address, role="supervisor",
+                                   metrics=m)
+                       if knobs.get_bool("COPYCAT_SERIES") else None)
         self._m_children = m.gauge("deploy.children")
         self._m_children_up = m.gauge("deploy.children_up")
         self._m_restarts = m.counter("deploy.restarts")
@@ -289,6 +297,9 @@ class Supervisor(Managed):
     async def _watch_health(self) -> None:
         while not self._closing:
             await asyncio.sleep(self._health_interval)
+            if self.series is not None:
+                # the deploy plane's series ring rides this cadence
+                self.series.maybe_sample(self.metrics.snapshot)
             for child in list(self._children.values()):
                 if child.state != RUNNING or not child.alive:
                     continue
@@ -406,7 +417,7 @@ class ControlListener(StatsListener):
         super().__init__(supervisor, host=host, port=port)
         self._sup = supervisor
 
-    def _route(self, path: str) -> tuple[bytes, str]:
+    def _route(self, path: str, query: str = "") -> tuple[bytes, str]:
         if path == "/topology":
             return self._sup.spec.to_json().encode(), "application/json"
         if path.startswith("/kill/"):
@@ -414,7 +425,7 @@ class ControlListener(StatsListener):
             ok, detail = self._sup.kill(name)
             return (json.dumps({"ok": ok, "detail": detail}).encode(),
                     "application/json")
-        return super()._route(path)
+        return super()._route(path, query)
 
 
 def run_foreground(spec: TopologySpec) -> int:

@@ -43,6 +43,7 @@ from ..protocol import messages as msg
 from ..protocol.operations import QueryConsistency
 from ..utils import knobs
 from ..utils.health import BlackBox, HealthMonitor
+from ..utils.timeseries import SeriesStore
 from ..utils.managed import Managed
 from ..utils.metrics import MetricsRegistry
 from ..utils.tracing import TRACER
@@ -167,6 +168,18 @@ class RaftServer(Managed):
         self._proxy_inflight = 0
         self.blackbox: BlackBox | None = None
         self.health: HealthMonitor | None = None
+        # Retrospective telemetry (docs/OBSERVABILITY.md "Retrospective
+        # telemetry"): the bounded series ring rides the health
+        # monitor's cadence — no task of its own — so it exists exactly
+        # when BOTH planes are on. COPYCAT_SERIES=0 removes the ring,
+        # the /series routes, the series.*/slo.* keys and the slo_burn
+        # detector, restoring the pre-series server bit-identically
+        # (A/B). Built BEFORE the monitor: the monitor probes `series`
+        # at construction to decide whether slo_burn runs.
+        self.series: SeriesStore | None = None
+        if self._health_enabled and knobs.get_bool("COPYCAT_SERIES"):
+            self.series = SeriesStore(node=self.address, role="member",
+                                      metrics=self._metrics)
         if self._health_enabled:
             if self.storage.directory:
                 self.blackbox = BlackBox(os.path.join(
@@ -1149,6 +1162,22 @@ class RaftServer(Managed):
                 len(s.event_queue) for grp in self.groups
                 for s in grp.sessions.values()),
         }
+
+    def series_tick(self) -> None:
+        """One retained metric sample if due — called from the health
+        monitor's tick (the series plane spawns no task of its own;
+        ``utils/timeseries.py``). No-op without a series store."""
+        if self.series is not None:
+            self.series.maybe_sample(self._series_snapshot)
+
+    def _series_snapshot(self) -> dict:
+        """What the series ring retains: the merged raft registry (all
+        per-group families under ``group=`` labels plus the server
+        families — health.*, slo.*, series.* included), with the lazy
+        gauges refreshed so role/term/lag are current at the sample."""
+        for grp in self.groups:
+            grp.refresh_gauges()
+        return self.metrics.snapshot()
 
     def device_flight(self) -> tuple[Any, int]:
         """``(flight ring, current engine round)`` when the server runs

@@ -33,6 +33,13 @@ Routes:
   With the health plane on, also carries the durable black-box
   (``utils/health.py``): the previous life's events reloaded at boot
   and tagged ``recovered=true`` — what post-SIGKILL forensics read.
+- ``/series`` — the retrospective-telemetry ring
+  (``utils/timeseries.py``): the host's retained metric samples,
+  windowable with ``?since=<wall seconds>`` and filterable with
+  ``?names=<prefix,prefix>``; ``/series.txt`` renders sparklines.
+  Served by every process role (member, ingress, supervisor) — what
+  ``copycat-tpu timeline`` merges. Absent under ``COPYCAT_SERIES=0``
+  (the pre-series surface, bit-identical).
 
 Enable with ``AtomixServer(..., stats_port=N)`` /
 ``copycat-server --stats-port N``; read with ``copycat-tpu stats
@@ -46,10 +53,29 @@ import json
 import logging
 from typing import Any
 
+from ..utils.buildinfo import healthz_identity
 from ..utils.metrics import MetricsRegistry
 from ..utils.tracing import TRACER
 
 logger = logging.getLogger(__name__)
+
+
+def _series_query(query: str) -> tuple[float | None, list[str] | None]:
+    """Parse ``?since=<wall seconds>&names=<prefix,prefix>`` for the
+    ``/series`` routes; malformed values degrade to the unfiltered
+    window rather than a 500 (observability never wounds)."""
+    since: float | None = None
+    names: list[str] | None = None
+    for part in query.split("&"):
+        key, _, value = part.partition("=")
+        if key == "since" and value:
+            try:
+                since = float(value)
+            except ValueError:
+                pass
+        elif key == "names" and value:
+            names = [n for n in value.split(",") if n]
+    return since, names
 
 
 class StatsListener:
@@ -97,12 +123,13 @@ class StatsListener:
             request_line = await asyncio.wait_for(reader.readline(), 5.0)
             parts = request_line.decode("latin-1").split()
             path = parts[1] if len(parts) >= 2 else "/"
-            # drain headers (ignored; every route is a parameterless GET)
+            # drain headers (ignored; routes take only query params)
             while True:
                 line = await asyncio.wait_for(reader.readline(), 5.0)
                 if line in (b"\r\n", b"\n", b""):
                     break
-            body, ctype = self._route(path.split("?", 1)[0])
+            raw_path, _, query = path.partition("?")
+            body, ctype = self._route(raw_path, query)
             writer.write(
                 b"HTTP/1.1 200 OK\r\n"
                 + f"Content-Type: {ctype}\r\n".encode()
@@ -126,7 +153,7 @@ class StatsListener:
             except Exception:
                 pass
 
-    def _route(self, path: str) -> tuple[bytes, str]:
+    def _route(self, path: str, query: str = "") -> tuple[bytes, str]:
         if path == "/metrics":
             return self._prometheus().encode(), "text/plain; version=0.0.4"
         if path == "/healthz":
@@ -134,14 +161,20 @@ class StatsListener:
             # registry walk — safe to poll at any frequency (the
             # deployment supervisor's watch cadence). Non-member hosts
             # (the standalone ingress tier) provide their own payload.
+            # Every role's payload carries uptime_s + git_sha
+            # (utils/buildinfo.py): a restarted or half-rolled child is
+            # distinguishable from one that was healthy all along.
             info = getattr(self._raft, "healthz_info", None)
             if callable(info):
-                return json.dumps(info()).encode(), "application/json"
-            g0 = self._raft.groups[0]
-            return (json.dumps({
-                "ok": True, "node": str(self._raft.address),
-                "role": g0.role, "term": g0.term,
-            }).encode(), "application/json")
+                payload = dict(info())
+            else:
+                g0 = self._raft.groups[0]
+                payload = {
+                    "ok": True, "node": str(self._raft.address),
+                    "role": g0.role, "term": g0.term,
+                }
+            payload.update(healthz_identity())
+            return json.dumps(payload).encode(), "application/json"
         if path == "/health":
             # the health plane's verdict (docs/OBSERVABILITY.md "Health
             # & diagnosis"): rate-limited re-evaluation — at most one
@@ -214,14 +247,28 @@ class StatsListener:
                     body += (f"#{ev.get('seq', '?'):<5} "
                              f"{ev.get('kind', '?'):<12} {extra}\n")
             return body.encode(), "text/plain"
+        store = getattr(self._raft, "series", None)
+        if path in ("/series", "/series.txt") and store is not None:
+            # the retrospective-telemetry ring (utils/timeseries.py):
+            # ?since=<wall s> windows, ?names=<prefix,...> filters —
+            # what `copycat-tpu timeline` fans out for. When the plane
+            # is off the path falls through to the unknown-route error:
+            # /series is ABSENT, not empty (the A/B surface).
+            since, names = _series_query(query)
+            if path == "/series":
+                return (json.dumps(store.payload(since=since, names=names))
+                        .encode(), "application/json")
+            return (store.render_text(since=since, names=names).encode(),
+                    "text/plain")
         if path in ("/", "/stats", "/stats.json"):
             return json.dumps(self._raft.stats_snapshot()).encode(), \
                 "application/json"
+        routes = ["/stats", "/metrics", "/health", "/healthz", "/traces",
+                  "/traces.txt", "/traces/<id>", "/flight", "/flight.txt"]
+        if store is not None:
+            routes += ["/series", "/series.txt"]
         return (json.dumps({"error": f"unknown path {path}",
-                            "routes": ["/stats", "/metrics", "/health",
-                                       "/healthz", "/traces",
-                                       "/traces.txt", "/traces/<id>",
-                                       "/flight", "/flight.txt"]}).encode(),
+                            "routes": routes}).encode(),
                 "application/json")
 
     def _device_hub(self):

@@ -115,6 +115,9 @@ def gate_artifact(artifact: dict, golden: dict) -> tuple[bool, str]:
 
 def update_golden(artifacts: list[dict], golden: dict) -> dict:
     for artifact in artifacts:
+        # only value/unit/meta are recorded — bulky run-local payloads
+        # ("metrics" snapshots, retained "series" windows) are tolerated
+        # on the artifact but never committed into the golden
         entry = {
             "value": artifact["value"],
             "unit": artifact.get("unit"),

@@ -50,6 +50,7 @@ from typing import Any, Iterable
 
 from . import knobs
 from .scheduled import Scheduled, schedule_repeating
+from .timeseries import series_onsets
 
 logger = logging.getLogger(__name__)
 
@@ -336,11 +337,132 @@ class IngressBacklogDetector(Detector):
             group, backlog=depth)
 
 
+class SloBurnDetector(Detector):
+    """Error-budget burn against the operator's service objectives
+    (``COPYCAT_SLO_P99_MS`` latency, ``COPYCAT_SLO_AVAIL``
+    availability), judged over the RETAINED series window
+    (``utils/timeseries.py``) — minutes of history, not the monitor's
+    short evidence deque — and exported as the ``slo.*`` gauge family.
+
+    Availability: an interval burns budget when any group's commit sat
+    frozen behind its log tail across the sample (lag open, commit not
+    advancing — the cluster could not serve that group). Burn rate is
+    the window error rate over the objective's error budget; sustained
+    burn >= 1x eats the whole budget, >= 10x is the classic fast-burn
+    page. Latency: the fraction of ACTIVE intervals (commit-latency
+    histogram advanced) whose sampled p99 exceeded the objective —
+    needs tracing on, since ``latency.commit_ms`` only advances for
+    traced requests.
+
+    Constructed only when the host server carries a series store
+    (``COPYCAT_SERIES=1`` + health plane on), so the off-plane stays
+    bit-identical."""
+
+    name = "slo_burn"
+    scope = "server"
+
+    def __init__(self, server: Any) -> None:
+        self.server = server
+        raw_p99 = knobs.get_raw("COPYCAT_SLO_P99_MS")
+        raw_avail = knobs.get_raw("COPYCAT_SLO_AVAIL")
+        self.p99_ms = float(raw_p99) if raw_p99 else None
+        self.avail = float(raw_avail) if raw_avail else None
+        # slo.* gauges exist only for objectives the operator actually
+        # set: an unconfigured detector leaves the registry untouched
+        m = server.metrics_server_registry()
+        self._m: dict = {}
+        if self.p99_ms is not None:
+            self._m["p99_objective_ms"] = m.gauge("slo.p99_objective_ms")
+            self._m["p99_observed_ms"] = m.gauge("slo.p99_observed_ms")
+            self._m["p99_burn"] = m.gauge("slo.p99_burn")
+            self._m["p99_objective_ms"].set(self.p99_ms)
+        if self.avail is not None:
+            self._m["avail_objective"] = m.gauge("slo.avail_objective")
+            self._m["avail_observed"] = m.gauge("slo.avail_observed")
+            self._m["avail_burn"] = m.gauge("slo.avail_burn")
+            self._m["avail_objective"].set(self.avail)
+
+    def evaluate(self, history, group):
+        store = getattr(self.server, "series", None)
+        if store is None or (self.p99_ms is None and self.avail is None):
+            return self._finding(OK, "", group)
+        rows = store.rows()
+        if len(rows) < 2:
+            return self._finding(OK, "", group)
+        sev = OK
+        reasons: list[str] = []
+        evidence: dict = {}
+        if self.avail is not None:
+            bad = 0
+            stuck_series: list[int] = []
+            lag_keys = sorted({k for _, v in rows for k in v
+                               if k.split("{", 1)[0] == "raft_commit_lag"})
+            for i in range(1, len(rows)):
+                prev_v, cur = rows[i - 1][1], rows[i][1]
+                stuck = any(
+                    cur.get(lk, 0) > 0
+                    and cur.get(lk.replace("raft_commit_lag",
+                                           "raft_commit_index", 1), 0)
+                    <= prev_v.get(lk.replace("raft_commit_lag",
+                                             "raft_commit_index", 1), 0)
+                    for lk in lag_keys)
+                stuck_series.append(1 if stuck else 0)
+                bad += 1 if stuck else 0
+            total = len(rows) - 1
+            error_rate = bad / total
+            observed = 1.0 - error_rate
+            burn = error_rate / max(1e-9, 1.0 - self.avail)
+            self._m["avail_observed"].set(round(observed, 6))
+            self._m["avail_burn"].set(round(burn, 3))
+            if burn >= 1.0:
+                sev = worst((sev, CRITICAL if burn >= 10.0 else WARN))
+                reasons.append(
+                    f"availability burn {burn:.1f}x budget (observed "
+                    f"{100 * observed:.2f}% vs objective "
+                    f"{100 * self.avail:.2f}% over {total} intervals)")
+                evidence["unavailable_intervals"] = stuck_series[-30:]
+        if self.p99_ms is not None:
+            judged = violations = 0
+            worst_p99 = 0.0
+            p99_series: list[float] = []
+            for i in range(1, len(rows)):
+                cur = rows[i][1]
+                if not any(v > 0 for k, v in cur.items()
+                           if k.startswith("latency.commit_ms")
+                           and k.endswith(".count")):
+                    continue
+                judged += 1
+                p = max((v for k, v in cur.items()
+                         if k.startswith("latency.commit_ms")
+                         and k.endswith(".p99")), default=0.0)
+                p99_series.append(round(p, 3))
+                worst_p99 = max(worst_p99, p)
+                if p > self.p99_ms:
+                    violations += 1
+            if judged:
+                frac = violations / judged
+                self._m["p99_observed_ms"].set(round(worst_p99, 3))
+                self._m["p99_burn"].set(round(frac, 3))
+                if frac >= 0.1:
+                    sev = worst((sev, CRITICAL if frac >= 0.5 else WARN))
+                    reasons.append(
+                        f"commit p99 {worst_p99:.1f}ms breached the "
+                        f"{self.p99_ms:.0f}ms objective in "
+                        f"{100 * frac:.0f}% of {judged} active intervals")
+                    evidence["commit_p99_ms"] = p99_series[-30:]
+        if sev == OK:
+            return self._finding(OK, "", group)
+        return self._finding(sev, "; ".join(reasons), group, **evidence)
+
+
 GROUP_DETECTORS = (LeaderChurnDetector, CommitStallDetector,
                    WindowCollapseDetector, FsyncSpikeDetector,
                    SessionExpiryDetector, SnapshotFailureDetector)
 SERVER_DETECTORS = (IngressBacklogDetector,)
-DETECTOR_NAMES = tuple(d.name for d in GROUP_DETECTORS + SERVER_DETECTORS)
+#: the catalog of detector names (docs/OBSERVABILITY.md) — slo_burn
+#: constructs with the host server, so it rides neither class tuple
+DETECTOR_NAMES = tuple(d.name for d in GROUP_DETECTORS
+                       + SERVER_DETECTORS) + (SloBurnDetector.name,)
 
 
 # ---------------------------------------------------------------------------
@@ -363,6 +485,12 @@ class HealthMonitor:
                           else knobs.get_int("COPYCAT_HEALTH_WINDOW"))
         self.group_detectors = [cls() for cls in GROUP_DETECTORS]
         self.server_detectors = [cls() for cls in SERVER_DETECTORS]
+        if getattr(server, "series", None) is not None:
+            # SLO burn judges the RETAINED series window, so it exists
+            # exactly when the series plane does — COPYCAT_SERIES=0
+            # keeps the detector set (and every health.* key)
+            # bit-identical to the pre-series plane
+            self.server_detectors.append(SloBurnDetector(server))
         self._history: dict[int, deque] = {}
         self._server_history: deque = deque(maxlen=self.window)
         self._timer: Scheduled | None = None
@@ -376,8 +504,8 @@ class HealthMonitor:
                             for sev in (WARN, CRITICAL)}
         self._m_status = m.gauge("health.status")
         self._m_detector = {
-            name: m.gauge("health.detector_status", detector=name)
-            for name in DETECTOR_NAMES}
+            d.name: m.gauge("health.detector_status", detector=d.name)
+            for d in self.group_detectors + self.server_detectors}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -413,6 +541,11 @@ class HealthMonitor:
         now = time.monotonic()
         self._last_tick = now
         self.server._attach_flight_spill()
+        # the retrospective-telemetry ring rides THIS cadence — the
+        # series plane spawns no task of its own (utils/timeseries.py)
+        tick_series = getattr(self.server, "series_tick", None)
+        if tick_series is not None:
+            tick_series()
         findings: list[Finding] = []
         for grp in self.server.groups:
             hist = self._history.get(grp.group_id)
@@ -619,6 +752,13 @@ _CAUSE_PHRASES = {
     "window_collapse": "replication window collapsed",
     "leader_churn": "election instability (leader churn)",
 }
+
+#: the retained series the doctor's retrospective scans for anomaly
+#: onsets — the families whose "when did it start" answers root-cause
+#: questions (lag/elections = consensus, latency./repl. = data plane,
+#: slo. = the burn itself)
+_RETRO_PREFIXES = ("raft_commit_lag", "raft_elections_started",
+                   "latency.", "repl.", "slo.")
 
 
 def _member_label(member: str, payload: dict | None) -> str:
@@ -833,7 +973,12 @@ def assemble_doctor_report(members: dict[str, dict],
                       "snapshot plane degraded — recovery will replay",
                       "ingress_backlog":
                       "group leaders saturated or unreachable from "
-                      "this ingress"}.get(r["detector"], r["detector"]),
+                      "this ingress",
+                      "slo_burn":
+                      "SLO error budget burning faster than the "
+                      "objective allows — see the retained window "
+                      "(doctor --last N / copycat-tpu timeline)"
+                      }.get(r["detector"], r["detector"]),
             "members": [r["member"]], "detectors": [r["detector"]],
         })
 
@@ -875,6 +1020,35 @@ def assemble_doctor_report(members: dict[str, dict],
         report["slowest_traces"] = [
             {"trace": t.get("trace"), "total_ms": t.get("total_ms")}
             for t in slowest_traces[:3]]
+
+    # 8. retrospective (doctor --last N): members whose payloads carry a
+    #    retained /series window get their anomaly ONSETS scanned —
+    #    "commit lag started climbing 40 s ago" time-correlates the
+    #    causes above instead of only grading the present. Members
+    #    without series (plane off, pre-series build, no --last) simply
+    #    contribute nothing — the section is additive, never required.
+    retrospect: dict[str, list] = {}
+    for key, payload in sorted(members.items()):
+        series = (payload or {}).get("series")
+        if not series:
+            continue
+        onsets = series_onsets(series, _RETRO_PREFIXES)
+        if onsets:
+            retrospect[_member_label(key, payload)] = onsets
+    if retrospect:
+        report["retrospect"] = retrospect
+        for c in causes:
+            notes = []
+            for m in c["members"]:
+                for o in retrospect.get(m, ())[:2]:
+                    start = ("window start"
+                             if o.get("from_window_start")
+                             else f"{o['ago_s']:.0f}s ago")
+                    notes.append(f"{m}: {o['key']} rose to "
+                                 f"{o['value']:g} from {start} "
+                                 f"(window median {o['median']:g})")
+            if notes:
+                c["retrospect"] = notes
     return report
 
 
@@ -895,6 +1069,19 @@ def render_doctor_report(report: dict) -> str:
         g = f" [group {c['group']}]" if c.get("group") is not None else ""
         lines.append(f"{i}. {c['severity'].upper()}{g} {c['symptom']}")
         lines.append(f"   cause: {c['cause']}")
+        for note in c.get("retrospect", ()):
+            lines.append(f"   onset: {note}")
     for t in report.get("slowest_traces", ()):
         lines.append(f"   slow trace {t['trace']}: {t['total_ms']} ms")
+    retrospect = report.get("retrospect") or {}
+    if retrospect:
+        lines.append("retrospective (retained series onsets):")
+        for member, onsets in retrospect.items():
+            for o in onsets:
+                start = ("breaching since window start"
+                         if o.get("from_window_start")
+                         else f"started {o['ago_s']:.0f}s ago")
+                lines.append(f"  {member:<24} {o['key']} -> "
+                             f"{o['value']:g} ({start}; window median "
+                             f"{o['median']:g})")
     return "\n".join(lines)

@@ -208,6 +208,34 @@ _knob("COPYCAT_BLACKBOX_BYTES", "int", 262144,
       "black-box spill bytes per generation (two generations kept; "
       "the crash-surviving flight-recorder ring on disk)",
       section="observability")
+_knob("COPYCAT_SERIES", "bool", True,
+      "`0` disables the retrospective-telemetry plane (the on-member "
+      "time-series ring, the `/series` routes, the `series.*`/`slo.*` "
+      "families) — the A/B knob restoring the pre-series plane "
+      "bit-identically; on members the ring rides the health-monitor "
+      "cadence, so `COPYCAT_HEALTH=0` also removes it",
+      section="observability")
+_knob("COPYCAT_SERIES_INTERVAL_S", "float", 1.0,
+      "seconds between retained metric samples (`utils/timeseries.py`; "
+      "sampling piggybacks the host cadence, so the effective interval "
+      "is at least the health/watch cadence)", section="observability")
+_knob("COPYCAT_SERIES_WINDOW", "int", 300,
+      "samples retained per process before oldest-first eviction — "
+      "the `/series` lookback is `interval x window` seconds",
+      section="observability")
+_knob("COPYCAT_SLO_P99_MS", "float", None,
+      default_doc="unset (= no latency objective)",
+      doc="commit-latency p99 objective in ms: the `slo_burn` detector "
+          "grades intervals whose sampled `latency.commit_ms` p99 "
+          "exceeds it (needs tracing on — the histogram only advances "
+          "for traced requests)", section="observability")
+_knob("COPYCAT_SLO_AVAIL", "float", None,
+      default_doc="unset (= no availability objective)",
+      doc="availability objective as a fraction (e.g. `0.999`): an "
+          "interval counts unavailable when a group's commit sat "
+          "frozen behind its log tail; the `slo_burn` detector grades "
+          "the error-budget burn rate over the retained window",
+      section="observability")
 
 # --- client ----------------------------------------------------------------
 _knob("COPYCAT_CLIENT_FOLLOWER_READS", "bool", True,

@@ -123,3 +123,23 @@ def test_committed_golden_covers_the_ci_smokes():
         assert "host" in entry["recorded"]
         assert "knobs" in entry["recorded"]
     assert 0 < golden["tolerance"] < 1
+
+
+def test_gate_tolerates_series_and_metrics_payloads(tmp_path):
+    """Artifacts now carry the run's retained /series windows next to
+    the metrics snapshots (bench.py SERIES_WINDOWS); the gate grades
+    the headline value identically and never commits either bulky
+    payload into the golden."""
+    artifact = _artifact(value=8000)
+    artifact["metrics"] = {"server": {"raft_term": 1}}
+    artifact["series"] = {"server": {"node": "n", "role": "member",
+                                     "samples": [{"t": 1.0,
+                                                  "values": {"x": 1}}]}}
+    ok, line = bench_gate.gate_artifact(artifact, _golden())
+    assert ok and "ok 8,000.0" in line
+    golden_path = tmp_path / "golden.json"
+    golden = bench_gate.load_golden(str(golden_path))
+    bench_gate.update_golden([artifact], golden)
+    entry = golden["scenarios"]["spi"]
+    assert "series" not in entry and "metrics" not in entry
+    assert entry["value"] == 8000

@@ -491,3 +491,38 @@ async def test_supervisor_config_error_is_terminal():
     finally:
         await sup.close()
         blocker.close()
+
+
+@async_test(timeout=60)
+async def test_deploy_tier_healthz_identity_and_series_route():
+    """Every deployed role's `/healthz` carries the process identity
+    (`uptime_s` + `git_sha`; members are covered in test_health), and
+    the deploy tiers serve their own `/series` ring
+    (docs/OBSERVABILITY.md § Retrospective telemetry)."""
+    from copycat_tpu.deploy.supervisor import ControlListener
+    from copycat_tpu.server.stats import StatsListener, fetch_stats
+
+    registry, servers = await _local_cluster(groups=1)
+    ingresses = await _ingress_tier(registry, servers, groups=1)
+    spec = TopologySpec.local(members=1, ingresses=0, storage="memory",
+                              machine=MACHINE_SPEC)
+    sup = Supervisor(spec)  # never opened: no children, just the surface
+    listeners = [await StatsListener(ingresses[0], port=0).open(),
+                 await ControlListener(sup, port=0).open()]
+    try:
+        import json as _json
+        roles = set()
+        for ln in listeners:
+            hz = _json.loads(await fetch_stats(
+                f"127.0.0.1:{ln.port}", "/healthz"))
+            assert hz["uptime_s"] >= 0.0
+            assert "git_sha" in hz
+            series = _json.loads(await fetch_stats(
+                f"127.0.0.1:{ln.port}", "/series"))
+            assert series["window"] >= 2
+            roles.add(series["role"])
+        assert roles == {"ingress", "supervisor"}
+    finally:
+        for ln in listeners:
+            await ln.close()
+        await _close_all(*ingresses, *servers)

@@ -442,12 +442,16 @@ async def test_nemesis_monotone_and_ryw_against_linearizable_witness(
     registry = LocalServerRegistry()
     nem = NetworkNemesis(seed=3)
     registry.attach_nemesis(nem)
-    servers = await _stack(registry, members=3, session_timeout=8.0)
+    # session_timeout is a harness parameter, not what's under test: it
+    # only needs to outlive any slow moment (cold jit compiles, a
+    # saturated CI host) so keep-alives never starve mid-nemesis —
+    # 8 s flaked as SessionExpiredError deep in the full suite
+    servers = await _stack(registry, members=3, session_timeout=20.0)
     addrs = [s.server.address for s in servers]
     writer = AtomixClient(addrs, LocalTransport(registry),
-                          session_timeout=8.0)
+                          session_timeout=20.0)
     reader = AtomixClient(addrs, LocalTransport(registry),
-                          session_timeout=8.0)
+                          session_timeout=20.0)
     await writer.open()
     await reader.open()
     try:

@@ -274,7 +274,7 @@ async def test_monitor_ok_on_healthy_cluster_and_routes():
         assert set(verdict["detectors"]) == {
             "leader_churn", "commit_stall", "window_collapse",
             "fsync_spike", "session_expiry", "snapshot_failure",
-            "ingress_backlog"}
+            "ingress_backlog", "slo_burn"}
         snap = leader.stats_snapshot()["raft"]
         assert snap["health.checks"] >= 1
         assert snap["health.status"] == 0
@@ -286,6 +286,11 @@ async def test_monitor_ok_on_healthy_cluster_and_routes():
             assert health["node"] == str(leader.address)
             healthz = json.loads(await fetch_stats(
                 f"127.0.0.1:{listener.port}", "/healthz"))
+            # uptime_s/git_sha (utils/buildinfo.py) ride every role's
+            # liveness payload: restart + half-rolled detection
+            assert healthz.pop("uptime_s") >= 0
+            assert "git_sha" in healthz  # None outside a checkout
+            healthz.pop("git_sha")
             assert healthz == {"ok": True, "node": str(leader.address),
                                "role": "leader", "term": leader.term}
             unknown = json.loads(await fetch_stats(
@@ -594,6 +599,114 @@ def test_blackbox_survives_crash_and_flight_serves_it(monkeypatch,
                            for e in bb["recovered"])
             finally:
                 await listener.close()
+        finally:
+            await cluster.close()
+
+    from helpers import arun
+    arun(run(), timeout=120)
+
+
+# ---------------------------------------------------------------------------
+# SLO burn detection (docs/OBSERVABILITY.md "Retrospective telemetry"):
+# objectives judged over the RETAINED series window, not the monitor's
+# short evidence deque
+# ---------------------------------------------------------------------------
+
+
+def _slo_rows(server, stuck, ok=0, t0=1000.0):
+    """Ingest synthetic retained samples: `stuck` intervals where a
+    group's commit sat frozen behind its log tail, then `ok` healthy
+    ones (lag closed, commit advancing)."""
+    commit = 100
+    gauges = ["raft_commit_lag", "raft_commit_index"]
+    for i in range(stuck):
+        server.series.ingest({"raft_commit_lag": 7,
+                              "raft_commit_index": commit,
+                              "_gauge_keys": gauges}, t=t0 + i)
+    for i in range(ok):
+        commit += 3
+        server.series.ingest({"raft_commit_lag": 0,
+                              "raft_commit_index": commit,
+                              "_gauge_keys": gauges}, t=t0 + stuck + i)
+
+
+def test_slo_burn_availability_grades_and_gauges(monkeypatch):
+    monkeypatch.setenv("COPYCAT_SLO_AVAIL", "0.99")
+
+    async def run():
+        cluster = await create_cluster(1)
+        try:
+            server = cluster.servers[0]
+            assert "slo_burn" in server.health.tick()["detectors"]
+            snap = server.stats_snapshot()["raft"]
+            assert snap["slo.avail_objective"] == 0.99
+            # ~1 stuck interval in ~21: burn ~5x the 1% budget -> WARN
+            _slo_rows(server, stuck=2, ok=20)
+            v = server.health.tick()
+            slo = v["detectors"]["slo_burn"]["groups"]["server"]
+            assert slo["status"] == WARN
+            assert "availability burn" in slo["reason"]
+            # a window that is mostly stuck: fast burn -> CRITICAL
+            _slo_rows(server, stuck=60, t0=2000.0)
+            v = server.health.tick()
+            slo = v["detectors"]["slo_burn"]["groups"]["server"]
+            assert slo["status"] == CRITICAL
+            assert slo["evidence"]["unavailable_intervals"]
+            snap = server.stats_snapshot()["raft"]
+            assert snap["slo.avail_burn"] >= 10
+            assert snap["slo.avail_observed"] < 1.0
+        finally:
+            await cluster.close()
+
+    from helpers import arun
+    arun(run(), timeout=120)
+
+
+def test_slo_burn_latency_objective(monkeypatch):
+    monkeypatch.setenv("COPYCAT_SLO_P99_MS", "10")
+
+    async def run():
+        cluster = await create_cluster(1)
+        try:
+            server = cluster.servers[0]
+            snap = server.stats_snapshot()["raft"]
+            assert snap["slo.p99_objective_ms"] == 10.0
+            # active intervals (commit-latency count advancing) whose
+            # sampled p99 breaches the objective in every interval
+            count = 0
+            for i in range(6):
+                count += 5
+                server.series.ingest(
+                    {"latency.commit_ms": {"count": count, "mean": 20.0,
+                                           "p50": 18.0, "p99": 25.0,
+                                           "max": 30.0}}, t=1000.0 + i)
+            v = server.health.tick()
+            slo = v["detectors"]["slo_burn"]["groups"]["server"]
+            assert slo["status"] == CRITICAL
+            assert "breached the 10ms objective" in slo["reason"]
+            snap = server.stats_snapshot()["raft"]
+            assert snap["slo.p99_observed_ms"] == 25.0
+            assert snap["slo.p99_burn"] == 1.0
+            # availability gauges were never registered: no objective
+            assert "slo.avail_objective" not in snap
+        finally:
+            await cluster.close()
+
+    from helpers import arun
+    arun(run(), timeout=120)
+
+
+def test_slo_burn_without_objectives_stays_ok():
+    async def run():
+        cluster = await create_cluster(1)
+        try:
+            server = cluster.servers[0]
+            _slo_rows(server, stuck=30)
+            v = server.health.tick()
+            slo = v["detectors"]["slo_burn"]["groups"]["server"]
+            assert slo["status"] == OK  # nothing configured, no grading
+            snap = server.stats_snapshot()["raft"]
+            assert not any(k.startswith("slo.") for k in snap)
         finally:
             await cluster.close()
 

@@ -1,0 +1,343 @@
+"""The retrospective-telemetry plane against live servers
+(docs/OBSERVABILITY.md "Retrospective telemetry"): the ``/series``
+routes, the ``COPYCAT_SERIES=0`` off-plane differential, the
+nemesis-driven timeline (fault mark before election spike), and the
+``doctor --last N`` retrospective."""
+
+import asyncio
+import json
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from copycat_tpu import cli  # noqa: E402
+from copycat_tpu.io.local import NetworkNemesis  # noqa: E402
+from copycat_tpu.server.log import Storage, StorageLevel  # noqa: E402
+from copycat_tpu.server.stats import StatsListener, fetch_stats  # noqa: E402
+from copycat_tpu.utils.health import assemble_doctor_report  # noqa: E402
+from copycat_tpu.utils.timeseries import (  # noqa: E402
+    assemble_timeline,
+    render_timeline,
+)
+
+from helpers import arun  # noqa: E402
+from raft_fixtures import Put, create_cluster  # noqa: E402
+
+
+def test_series_route_serves_windowed_samples(monkeypatch):
+    monkeypatch.setenv("COPYCAT_SERIES_INTERVAL_S", "0.05")
+
+    async def run():
+        cluster = await create_cluster(1)
+        try:
+            server = cluster.servers[0]
+            assert server.series is not None
+            client = await cluster.client()
+            for i in range(4):
+                await client.submit(Put(key=f"k{i}", value=i))
+                server.series_tick()
+                await asyncio.sleep(0.06)
+            listener = await StatsListener(server, port=0).open()
+            try:
+                addr = f"127.0.0.1:{listener.port}"
+                p = json.loads(await fetch_stats(addr, "/series"))
+                assert p["node"] == str(server.address)
+                assert p["role"] == "member"
+                assert len(p["samples"]) >= 2
+                sample = p["samples"][-1]["values"]
+                # gauges sampled as-is, counters as per-interval deltas
+                assert sample["raft_commit_index"] >= 1
+                assert "commands_single_lane" in sample
+                # the series.* self-family rides the ring too
+                assert "series.samples" in sample
+                # ?since windows, ?names prefix-filters
+                mid = p["samples"][1]["t"]
+                since = json.loads(await fetch_stats(
+                    addr, f"/series?since={mid}"))
+                assert all(r["t"] > mid for r in since["samples"])
+                assert len(since["samples"]) < len(p["samples"])
+                named = json.loads(await fetch_stats(
+                    addr, "/series?names=raft_commit"))
+                assert named["samples"]
+                assert all(k.startswith("raft_commit")
+                           for r in named["samples"] for k in r["values"])
+                text = (await fetch_stats(addr, "/series.txt")).decode()
+                assert "raft_commit_index" in text
+                unknown = json.loads(await fetch_stats(addr, "/nope"))
+                assert "/series" in unknown["routes"]
+                assert "/series.txt" in unknown["routes"]
+            finally:
+                await listener.close()
+        finally:
+            await cluster.close()
+
+    arun(run(), timeout=120)
+
+
+def test_series_off_knob_removes_the_plane(monkeypatch):
+    """COPYCAT_SERIES=0 differential: no store, no /series route, no
+    series.*/slo.* registry keys, no slo_burn detector gauge — the
+    registry key set and detector set match the pre-series plane
+    exactly (the bit-identity A/B the plane is gated on)."""
+
+    async def snapshot_keys():
+        cluster = await create_cluster(1)
+        try:
+            server = cluster.servers[0]
+            client = await cluster.client()
+            await client.submit(Put(key="k", value=1))
+            server.health.tick()
+            listener = await StatsListener(server, port=0).open()
+            try:
+                addr = f"127.0.0.1:{listener.port}"
+                series_body = json.loads(await fetch_stats(addr, "/series"))
+                unknown = json.loads(await fetch_stats(addr, "/nope"))
+                snap = server.stats_snapshot()["raft"]
+                detectors = set(server.health.tick()["detectors"])
+                return (server.series, series_body, unknown["routes"],
+                        set(snap), detectors)
+            finally:
+                await listener.close()
+        finally:
+            await cluster.close()
+
+    monkeypatch.setenv("COPYCAT_SERIES", "0")
+    store_off, series_off, routes_off, keys_off, det_off = arun(
+        snapshot_keys(), timeout=120)
+    assert store_off is None
+    # /series is ABSENT, not empty: the unknown-route error, unlisted
+    assert "error" in series_off and "/series" not in routes_off
+    assert not any(k.startswith(("series.", "slo.")) for k in keys_off)
+
+    monkeypatch.setenv("COPYCAT_SERIES", "1")
+    store_on, series_on, routes_on, keys_on, det_on = arun(
+        snapshot_keys(), timeout=120)
+    assert store_on is not None
+    assert "samples" in series_on and "/series" in routes_on
+    # the on-plane adds EXACTLY the series.* self-family, the slo_burn
+    # detector and its status gauge (slo.* data gauges need objectives
+    # set); everything else is bit-identical
+    assert keys_on - keys_off == {
+        "series.samples", "series.evictions", "series.names",
+        "health.detector_status{detector=slo_burn}"}
+    assert det_on - det_off == {"slo_burn"}
+
+
+def test_nemesis_timeline_fault_before_election(monkeypatch, tmp_path):
+    """The acceptance differential: a 3-member cluster with a fault
+    mark recorded at injection time, then a full partition forcing
+    elections — the merged timeline renders the fault mark BEFORE the
+    election spike, member-attributed, on every member that spiked."""
+    monkeypatch.setenv("COPYCAT_SERIES_INTERVAL_S", "0.05")
+
+    async def run():
+        cluster = await create_cluster(
+            3, election_timeout=0.15, heartbeat_interval=0.03,
+            storage_factory=lambda i: Storage(
+                StorageLevel.DISK, str(tmp_path / str(i)),
+                max_entries_per_segment=64))
+        listeners = []
+        try:
+            client = await cluster.client()
+            for i in range(5):
+                await client.submit(Put(key=f"k{i}", value=i))
+            for s in cluster.servers:
+                s.series_tick()
+            await asyncio.sleep(0.06)
+            # the fault mark: recorded durably on every member at
+            # injection time (what the device-plane nemesis does via
+            # the flight ring; the host black-box is the CPU-plane home)
+            for s in cluster.servers:
+                s.health_note("fault", fault="partition")
+            nemesis = cluster.registry.attach_nemesis(NetworkNemesis())
+            nemesis.partition(*[[s.address] for s in cluster.servers])
+            # isolated followers time out and start elections; keep
+            # sampling until >= 2 members retained an election spike
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while asyncio.get_running_loop().time() < deadline:
+                await asyncio.sleep(0.06)
+                spiked = 0
+                for s in cluster.servers:
+                    s.series_tick()
+                    if any(r["values"].get("raft_elections_started")
+                           for r in s.series.payload()["samples"]):
+                        spiked += 1
+                if spiked >= 2:
+                    break
+            assert spiked >= 2, "partition forced no election spikes"
+            nemesis.heal()
+            # assemble over the REAL wire: one listener per member, the
+            # CLI's fan-out, the shipped assembler
+            for s in cluster.servers:
+                listeners.append(await StatsListener(s, port=0).open())
+            addrs = [f"127.0.0.1:{ln.port}" for ln in listeners]
+            members, failed = await cli.collect_timeline(addrs)
+            assert not failed and len(members) == 3
+            timeline = assemble_timeline(members, failed_members=failed,
+                                         last_s=60)
+            assert timeline["incomplete"] is False
+            assert len(timeline["members"]) == 3
+            ts = [e["t"] for e in timeline["events"]]
+            assert ts == sorted(ts)  # merged stream is time-ordered
+            election_members = set()
+            for node in timeline["members"]:
+                mine = [e for e in timeline["events"]
+                        if e["member"] == node]
+                faults = [e for e in mine if e["kind"] == "fault"]
+                elections = [e for e in mine if e["kind"] == "election"]
+                assert faults, f"{node}: fault mark missing"
+                if elections:
+                    election_members.add(node)
+                    # the differential: cause strictly before symptom
+                    assert min(f["t"] for f in faults) \
+                        <= min(e["t"] for e in elections), node
+            assert len(election_members) >= 2
+            text = render_timeline(timeline)
+            assert "fault" in text and "election" in text
+        finally:
+            for ln in listeners:
+                await ln.close()
+            await cluster.close()
+
+    arun(run(), timeout=180)
+
+
+def test_doctor_last_pulls_series_and_reports_onsets(monkeypatch):
+    monkeypatch.setenv("COPYCAT_SERIES_INTERVAL_S", "0.05")
+
+    async def run():
+        cluster = await create_cluster(1)
+        try:
+            server = cluster.servers[0]
+            client = await cluster.client()
+            await client.submit(Put(key="k", value=1))
+            # a quiet baseline, then a lag breach — the onset shape
+            # (real wall timestamps: /series?since= windows on them)
+            import time
+            t0 = time.time() - 7.0
+            base = server._series_snapshot()
+            for i in range(6):
+                server.series.ingest(dict(base), t=t0 + i)
+            spike = dict(base)
+            spike["raft_commit_lag"] = 40
+            server.series.ingest(spike, t=t0 + 6)
+            listener = await StatsListener(server, port=0).open()
+            try:
+                addr = f"127.0.0.1:{listener.port}"
+                members, failed, traces = await cli.collect_doctor(
+                    [addr], last_s=3600.0)
+                payload = members[addr]
+                assert payload["series"] is not None
+                assert payload["series"]["samples"]
+                report = assemble_doctor_report(members,
+                                                failed_members=failed)
+                node = str(server.address)
+                assert node in report["retrospect"]
+                onset = report["retrospect"][node][0]
+                assert onset["key"] == "raft_commit_lag"
+                assert onset["value"] == 40
+                # without --last no series is fetched and no
+                # retrospect section appears
+                members2, _, _ = await cli.collect_doctor([addr])
+                assert "series" not in members2[addr]
+                report2 = assemble_doctor_report(members2)
+                assert "retrospect" not in report2
+            finally:
+                await listener.close()
+        finally:
+            await cluster.close()
+
+    arun(run(), timeout=120)
+
+
+def _ns(**kw):
+    return type("A", (), kw)()
+
+
+def test_cli_timeline_verb_json_and_text(capsys, monkeypatch):
+    monkeypatch.setenv("COPYCAT_SERIES_INTERVAL_S", "0.05")
+
+    async def run():
+        cluster = await create_cluster(1)
+        try:
+            client = await cluster.client()
+            await client.submit(Put(key="k", value=1))
+            server = cluster.servers[0]
+            server.series_tick()
+            await asyncio.sleep(0.06)
+            server.series_tick()
+            listener = await StatsListener(server, port=0).open()
+            try:
+                addr = f"127.0.0.1:{listener.port}"
+                # to_thread: the verb owns its own asyncio.run, like
+                # the real process would
+                rc = await asyncio.to_thread(
+                    cli._timeline, _ns(addresses=[addr], last=60.0,
+                                       names=None, json=True))
+                assert rc == 0
+                timeline = json.loads(capsys.readouterr().out)
+                assert timeline["members"] == [str(server.address)]
+                assert timeline["incomplete"] is False
+                assert timeline["series"][timeline["members"][0]]
+                rc = await asyncio.to_thread(
+                    cli._timeline, _ns(addresses=[addr], last=60.0,
+                                       names="raft_commit_index",
+                                       json=False))
+                assert rc == 0
+                out = capsys.readouterr().out
+                assert "cluster timeline" in out
+                assert "raft_commit_index" in out
+            finally:
+                await listener.close()
+        finally:
+            await cluster.close()
+
+    arun(run(), timeout=120)
+    # a fully unreachable cluster is a one-line error + exit 1
+    rc = cli._timeline(_ns(addresses=["127.0.0.1:1"], last=60.0,
+                           names=None, json=True))
+    assert rc == 1
+    assert "--stats-port" in capsys.readouterr().err
+
+
+def test_cli_top_once(capsys):
+    async def run():
+        cluster = await create_cluster(1)
+        try:
+            client = await cluster.client()
+            await client.submit(Put(key="k", value=1))
+            listener = await StatsListener(cluster.servers[0],
+                                           port=0).open()
+            try:
+                addr = f"127.0.0.1:{listener.port}"
+                rc = await asyncio.to_thread(
+                    cli._top, _ns(addresses=[addr, "127.0.0.1:1"],
+                                  watch=0.1, once=True))
+                assert rc == 0
+                out = capsys.readouterr().out
+                assert "cluster top" in out
+                assert str(cluster.servers[0].address) in out
+                # the dead addr renders as a row, never drops
+                assert "UNREACHABLE" in out
+            finally:
+                await listener.close()
+        finally:
+            await cluster.close()
+
+    arun(run(), timeout=120)
+    rc = cli._top(_ns(addresses=["127.0.0.1:1"], watch=0.1, once=True))
+    assert rc == 1
+
+
+def test_cli_parser_registers_new_verbs_and_doctor_last(capsys):
+    import pytest as _pytest
+
+    for argv in (["timeline"], ["top"]):
+        with _pytest.raises(SystemExit):
+            cli.main(argv)  # addresses are required
+        capsys.readouterr()
+    with _pytest.raises(SystemExit) as e:
+        cli.main(["doctor", "--last", "nope", "127.0.0.1:1"])
+    assert e.value.code == 2  # --last takes a float
+    capsys.readouterr()

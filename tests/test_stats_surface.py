@@ -274,6 +274,24 @@ def test_watch_renderer_keeps_labeled_series_distinct():
                     "raft.query_windows"]
 
 
+def test_watch_renderer_orders_numeric_labels_numerically():
+    """A wide multi-group watch stays in shard order: `group=2` sorts
+    before `group=10` (numeric label comparison, not lexicographic), and
+    the ordering is stable across delta frames."""
+    snap = {"node": "n", "raft": {
+        f"raft_term{{group={g}}}": 1 for g in (10, 2, 1, 0)}}
+    prev = cli._flatten_numeric(snap)
+    frame = cli._render_watch(snap, prev, 1.0)
+    keys = [ln.split()[0] for ln in frame.splitlines()
+            if "raft_term" in ln]
+    assert keys == [f"raft.raft_term{{group={g}}}" for g in (0, 1, 2, 10)]
+    # same order with no prev (first frame) — stable family sort
+    frame0 = cli._render_watch(snap, None, 0.0)
+    keys0 = [ln.split()[0] for ln in frame0.splitlines()
+             if "raft_term" in ln]
+    assert keys0 == keys
+
+
 def test_watch_renderer_shows_apply_family_deltas():
     """`--watch` renders the apply.* family (parallel-apply spans on the
     group registries, fused-dispatch counters on the server registry)

@@ -1,0 +1,365 @@
+"""Retrospective telemetry units (``utils/timeseries.py``): the
+delta-encoded ring's bounds and encoding, payload windowing, the
+cluster-timeline assembly/rendering, the ``top`` frame, and the
+onset detection behind ``doctor --last N``.
+
+Pure-python on synthetic payloads — no jax, no sockets; the live-server
+side lives in ``test_series_surface.py``.
+"""
+
+from copycat_tpu.utils.timeseries import (
+    DEFAULT_TIMELINE_PREFIXES,
+    SeriesStore,
+    assemble_timeline,
+    flatten_registry,
+    render_timeline,
+    render_top,
+    resample,
+    series_onsets,
+    series_sort_key,
+    sparkline,
+)
+
+
+# ---------------------------------------------------------------------------
+# ordering + flattening primitives
+# ---------------------------------------------------------------------------
+
+
+def test_series_sort_key_groups_labeled_with_family():
+    keys = ["raft_term", "raft_commit_index{group=0}", "zzz",
+            "raft_commit_index{group=1}", "raft_commit_index"]
+    ordered = sorted(keys, key=series_sort_key)
+    # the labeled variants sort WITH the unlabeled family head, not
+    # after every other name (ASCII '{' > letters)
+    assert ordered == ["raft_commit_index", "raft_commit_index{group=0}",
+                       "raft_commit_index{group=1}", "raft_term", "zzz"]
+
+
+def test_series_sort_key_numeric_label_values():
+    keys = [f"c{{group={g}}}" for g in (10, 2, 1)]
+    assert sorted(keys, key=series_sort_key) == [
+        "c{group=1}", "c{group=2}", "c{group=10}"]
+    # non-numeric values still order, lexicographically
+    assert sorted(["c{peer=b}", "c{peer=a}"], key=series_sort_key) == [
+        "c{peer=a}", "c{peer=b}"]
+
+
+def test_flatten_registry_histograms_and_hints():
+    snap = {
+        "ops": 7,
+        "depth": 3.5,
+        "flag": True,
+        "lat": {"count": 9, "mean": 1.0, "p50": 0.8, "p99": 2.0,
+                "max": 3.0},
+        "_gauge_keys": ["depth"],
+        "uptime_s": 123.0,
+        "weird": {"not": "a-histogram"},
+    }
+    values, gauge_keys = flatten_registry(snap)
+    assert values["ops"] == 7 and values["flag"] == 1
+    assert values["lat.p50"] == 0.8 and values["lat.p99"] == 2.0
+    assert values["lat.count"] == 9
+    # p50/p99 sample like gauges; .count delta-encodes like a counter
+    assert gauge_keys == {"depth", "lat.p50", "lat.p99"}
+    assert "uptime_s" not in values and "_gauge_keys" not in values
+    assert "weird" not in values
+
+
+# ---------------------------------------------------------------------------
+# the ring: delta encoding, bounds, queries
+# ---------------------------------------------------------------------------
+
+
+def _store(window=4):
+    return SeriesStore(node="n1", role="member", interval_s=1.0,
+                       window=window)
+
+
+def test_counters_delta_encode_and_gauges_sample():
+    s = _store()
+    base = 100.0
+    for i in range(3):
+        s.ingest({"ops": 10 * (i + 1), "depth": float(i),
+                  "_gauge_keys": ["depth"]}, t=base + i)
+    rows = s.rows()
+    # first sight of a counter contributes 0 (history starts now)
+    assert [r[1]["ops"] for r in rows] == [0, 10, 10]
+    assert [r[1]["depth"] for r in rows] == [0.0, 1.0, 2.0]
+
+
+def test_ring_eviction_bounds_memory():
+    s = _store(window=4)
+    for i in range(10):
+        s.ingest({"ops": i}, t=1000.0 + i)
+    rows = s.rows()
+    assert len(rows) == 4  # never more than the window
+    assert rows[0][0] == 1006.0  # oldest-first eviction
+    assert s.samples_taken == 10 and s.evictions == 6
+    p = s.payload()
+    assert p["samples_taken"] == 10 and p["evictions"] == 6
+    assert len(p["samples"]) == 4
+
+
+def test_payload_since_and_names_filters():
+    s = _store(window=8)
+    for i in range(5):
+        s.ingest({"raft_commit_index": i, "other": i,
+                  "_gauge_keys": ["raft_commit_index", "other"]},
+                 t=2000.0 + i)
+    p = s.payload(since=2002.0)
+    assert [r["t"] for r in p["samples"]] == [2003.0, 2004.0]
+    p = s.payload(names=["raft_commit"])
+    assert all(set(r["values"]) == {"raft_commit_index"}
+               for r in p["samples"])
+    # prefix match covers labeled variants too
+    s.ingest({"raft_commit_index{group=1}": 9,
+              "_gauge_keys": ["raft_commit_index{group=1}"]}, t=2005.0)
+    p = s.payload(since=2004.5, names=["raft_commit_index"])
+    assert set(p["samples"][-1]["values"]) == {"raft_commit_index{group=1}"}
+
+
+def test_maybe_sample_respects_interval_and_bad_snapshots():
+    s = SeriesStore(node="n", role="member", interval_s=1000.0, window=4)
+    assert s.maybe_sample(lambda: {"ops": 1}) is True
+    # next sample not due for 1000s — snap_fn must not even be called
+    assert s.maybe_sample(lambda: 1 / 0) is False
+    assert s.samples_taken == 1
+    # a due sample whose snapshot raises is swallowed (observability
+    # must never wound the host), not retained
+    s2 = SeriesStore(node="n", role="member", interval_s=0.05, window=4)
+    assert s2.maybe_sample(lambda: 1 / 0) is False
+    assert s2.samples_taken == 0
+
+
+def test_render_text_sparklines():
+    s = _store(window=8)
+    for i in range(4):
+        s.ingest({"g": float(i), "_gauge_keys": ["g"]}, t=3000.0 + i)
+    text = s.render_text()
+    assert "member n1: 4 sample(s)" in text
+    assert "g" in text and "min 0 max 3" in text
+    assert SeriesStore(node="x", interval_s=1, window=2) \
+        .render_text().endswith("(no samples retained)\n")
+
+
+# ---------------------------------------------------------------------------
+# grid primitives
+# ---------------------------------------------------------------------------
+
+
+def test_sparkline_scaling_and_gaps():
+    assert sparkline([]) == ""
+    assert sparkline([5, 5, 5]) == "▁▁▁"  # flat renders at the floor
+    line = sparkline([0, None, 10])
+    assert line[0] == "▁" and line[1] == " " and line[2] == "█"
+
+
+def test_resample_means_and_gaps():
+    samples = [{"t": t, "values": {"k": v}}
+               for t, v in ((0.5, 2.0), (0.6, 4.0), (3.5, 9.0))]
+    out = resample(samples, "k", 0.0, 4.0, 4)
+    assert out == [3.0, None, None, 9.0]  # mean per bucket, None gaps
+    assert resample(samples, "k", 4.0, 0.0, 4) == []
+
+
+# ---------------------------------------------------------------------------
+# timeline assembly
+# ---------------------------------------------------------------------------
+
+
+def _member_payload(node, t0, commits, events=(), role="member"):
+    samples = [{"t": t0 + i, "values": {"raft_commit_index": c}}
+               for i, c in enumerate(commits)]
+    return {
+        "series": {"node": node, "role": role, "interval_s": 1.0,
+                   "window": 300, "now": t0 + len(commits),
+                   "samples": samples},
+        "flight": {"events": list(events)},
+        "health": {"status": "ok", "node": node, "role": role},
+    }
+
+
+def test_assemble_timeline_merges_and_marks_incomplete():
+    t0 = 1000.0
+    m1 = _member_payload("n1", t0, [1, 2, 3, 4],
+                         events=[{"t": t0 + 1, "kind": "fault",
+                                  "fault": "partition"}])
+    m2 = {"series": None, "flight": None,
+          "health": {"status": "warn", "node": "n2"}}
+    tl = assemble_timeline({"a:1": m1, "a:2": m2},
+                           failed_members=["a:3"], last_s=60)
+    assert tl["incomplete"] is True
+    assert "member a:3 unreachable" in tl["incomplete_why"]
+    assert any("n2 serves no /series" in w for w in tl["incomplete_why"])
+    # every member renders — the series-less and the unreachable never
+    # drop the reachable one's data
+    assert tl["members"] == ["n1", "n2"]
+    assert tl["series"]["n1"]["raft_commit_index"]
+    assert tl["series"]["n2"] == {}
+    assert [e["kind"] for e in tl["events"]] == ["fault"]
+    text = render_timeline(tl)
+    assert "!! INCOMPLETE" in text
+    assert "n1 [member]" in text and "fault" in text
+
+
+def test_timeline_derives_election_events_from_series():
+    t0 = 2000.0
+    payload = _member_payload("n1", t0, [1, 2, 3, 4])
+    payload["series"]["samples"][2]["values"][
+        "raft_elections_started"] = 2
+    tl = assemble_timeline({"a:1": payload}, last_s=60)
+    ev = [e for e in tl["events"] if e["kind"] == "election"]
+    assert len(ev) == 1 and ev[0]["t"] == t0 + 2
+    assert ev[0]["detail"] == "+2 election(s)"
+
+
+def test_timeline_orders_fault_before_election_per_member():
+    """The nemesis differential's pure core: a fault mark at T and an
+    election spike at T+dt merge time-ordered and member-attributed on
+    every member."""
+    t0 = 3000.0
+    members = {}
+    for i in range(3):
+        node = f"n{i}"
+        payload = _member_payload(node, t0, [5, 5, 5, 5])
+        payload["flight"]["events"] = [
+            {"t": t0 + 1, "kind": "fault", "fault": "partition"}]
+        payload["series"]["samples"][3]["values"][
+            "raft_elections_started"] = 1
+        members[f"a:{i}"] = payload
+    tl = assemble_timeline(members, last_s=60)
+    assert tl["incomplete"] is False
+    for i in range(3):
+        node = f"n{i}"
+        mine = [e for e in tl["events"] if e["member"] == node]
+        kinds = [e["kind"] for e in mine]
+        assert kinds == ["fault", "election"], kinds
+        assert mine[0]["t"] < mine[1]["t"]
+    # and the global merge is time-sorted
+    ts = [e["t"] for e in tl["events"]]
+    assert ts == sorted(ts)
+
+
+def test_timeline_keeps_recovered_events_outside_window():
+    t0 = 5000.0
+    payload = _member_payload("n1", t0, [1, 2])
+    payload["flight"] = {
+        "events": [],
+        "blackbox": {"events": [
+            {"t": t0 - 900.0, "kind": "fault", "fault": "kill",
+             "recovered": True}]}}
+    tl = assemble_timeline({"a:1": payload}, last_s=30)
+    assert any(e["kind"] == "fault" and e["recovered"]
+               for e in tl["events"])
+
+
+def test_timeline_default_prefixes_filter_series():
+    t0 = 6000.0
+    payload = _member_payload("n1", t0, [1, 2, 3])
+    for row in payload["series"]["samples"]:
+        row["values"]["transport_bytes_out"] = 1
+    tl = assemble_timeline({"a:1": payload}, last_s=60)
+    assert set(tl["series"]["n1"]) == {"raft_commit_index"}
+    tl = assemble_timeline({"a:1": payload}, last_s=60,
+                           names=["transport_"])
+    assert set(tl["series"]["n1"]) == {"transport_bytes_out"}
+    assert "raft_commit_index" in DEFAULT_TIMELINE_PREFIXES
+
+
+# ---------------------------------------------------------------------------
+# the `top` frame
+# ---------------------------------------------------------------------------
+
+
+def _top_member(commit, leader=True, groups=None):
+    stats = {"node": "n1", "role": "leader" if leader else "follower",
+             "term": 3,
+             "raft": {"raft_commit_index": commit,
+                      "repl.windows_inflight": 2,
+                      "commands_fast_lane": commit * 2,
+                      "commands_general_lane": 0,
+                      "commands_single_lane": 0}}
+    if groups is not None:
+        stats["groups"] = groups
+    return {"stats": stats, "health": {"status": "ok"}}
+
+
+def test_render_top_rates_need_two_frames():
+    frame1, state = render_top({"a:1": _top_member(100)}, [], None, 0.0)
+    assert "-" in frame1  # no rate on the first frame
+    frame2, _ = render_top({"a:1": _top_member(150)}, [], state, 2.0)
+    assert "25.0/s" in frame2
+    assert "100/0/0%" in frame2  # lane mix: all fast-lane
+    assert "worst health: OK" in frame2
+
+
+def test_render_top_unreachable_and_verdict():
+    frame, _ = render_top({"a:1": _top_member(1)}, ["a:2", "a:3"],
+                          None, 0.0)
+    rows = [ln for ln in frame.splitlines() if ln.endswith("UNREACHABLE")]
+    assert len(rows) == 2
+    assert "1/3 member(s) up" in frame
+    assert "worst health: UNREACHABLE" in frame
+    bad = _top_member(1)
+    bad["health"]["status"] = "critical"
+    frame, _ = render_top({"a:1": bad}, ["a:2"], None, 0.0)
+    assert "worst health: CRITICAL" in frame
+
+
+def test_render_top_multi_group_rows():
+    groups = {"0": {"role": "leader", "term": 2, "commit_index": 10,
+                    "log_last_index": 12},
+              "1": {"role": "follower", "term": 2, "commit_index": 5,
+                    "log_last_index": 5}}
+    frame, _ = render_top({"a:1": _top_member(15, groups=groups)},
+                          [], None, 0.0)
+    assert "1/2 led" in frame
+    assert "group 0: leader" in frame and "lag 2" in frame
+
+
+# ---------------------------------------------------------------------------
+# onset detection (doctor --last N)
+# ---------------------------------------------------------------------------
+
+
+def _series_of(key, values, t0=1000.0):
+    return {"now": t0 + len(values),
+            "samples": [{"t": t0 + i, "values": {key: v}}
+                        for i, v in enumerate(values)]}
+
+
+def test_series_onsets_finds_the_breach_start():
+    payload = _series_of("raft_commit_lag", [0, 0, 0, 0, 0, 0, 9, 12])
+    onsets = series_onsets(payload, ["raft_commit_lag"])
+    assert len(onsets) == 1
+    o = onsets[0]
+    assert o["key"] == "raft_commit_lag" and o["value"] == 9
+    assert o["t"] == 1006.0 and o["median"] == 0
+    assert o["from_window_start"] is False
+
+
+def test_series_onsets_always_breaching_flags_window_start():
+    payload = _series_of("raft_commit_lag", [9, 9, 10, 11])
+    onsets = series_onsets(payload, ["raft_commit_lag"], factor=3.0)
+    # median 9.5-ish -> threshold ~28: no onset inside the window
+    # unless the first sample itself breaches factor x median
+    payload = _series_of("latency.p99", [50, 50, 50, 50])
+    assert series_onsets(payload, ["latency."]) == []
+    # a series above threshold from sample 0 reports window-start
+    payload = _series_of("x", [5, 0, 0, 0, 0, 0, 0, 0])
+    onsets = series_onsets(payload, ["x"])
+    assert onsets and onsets[0]["from_window_start"] is True
+
+
+def test_series_onsets_prefix_filter_and_cap():
+    t0 = 1000.0
+    values = {f"k{i}": 0 for i in range(12)}
+    samples = [{"t": t0 + j, "values": dict(values)} for j in range(6)]
+    for i in range(12):
+        samples[-1]["values"][f"k{i}"] = 5
+    payload = {"now": t0 + 6, "samples": samples}
+    onsets = series_onsets(payload, ["k"], cap=8)
+    assert len(onsets) == 8  # capped
+    assert series_onsets(payload, ["nope"]) == []
+    assert series_onsets({}, ["k"]) == []
