@@ -2668,6 +2668,14 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
         degraded = True
     enable_compilation_cache()
+    # The bench holds its OWN profiler reference for the whole run: the
+    # scenario's servers acquire/release around their lifetime, so by
+    # artifact-write time their refs are gone and the singleton would
+    # be torn down — this ref keeps the sampled window alive for the
+    # top-frame summary below. COPYCAT_PROFILE=0 -> None -> no
+    # "profile" key in the artifact (A/B).
+    from .utils import profiler as _profiler
+    bench_profiler = _profiler.acquire()
     if SCENARIO == "election":
         result = run_election()
     elif SCENARIO == "map_read":
@@ -2703,14 +2711,21 @@ def main() -> None:
     if degraded:
         result["degraded"] = True
     if args.metrics_json:
+        artifact = {**result, "scenario": SCENARIO,
+                    "meta": _artifact_meta(),
+                    "metrics": METRICS_SNAPSHOTS,
+                    # the run's retained /series windows (empty under
+                    # COPYCAT_SERIES=0) — the gate reads none of it
+                    "series": SERIES_WINDOWS}
+        if bench_profiler is not None:
+            # where the run's wall time actually went (the continuous
+            # profiler's top-frame summary + the plane's own counters);
+            # absent under COPYCAT_PROFILE=0 — the gate reads none of it
+            artifact["profile"] = bench_profiler.top_summary(top=10)
         with open(args.metrics_json, "w") as f:
-            json.dump({**result, "scenario": SCENARIO,
-                       "meta": _artifact_meta(),
-                       "metrics": METRICS_SNAPSHOTS,
-                       # the run's retained /series windows (empty under
-                       # COPYCAT_SERIES=0) — the gate reads none of it
-                       "series": SERIES_WINDOWS}, f)
+            json.dump(artifact, f)
         log(f"bench: metrics snapshot written to {args.metrics_json}")
+    _profiler.release(bench_profiler)
     print(json.dumps(result))
 
 

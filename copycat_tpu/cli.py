@@ -641,10 +641,12 @@ def _top(args: argparse.Namespace) -> int:
     — a cluster-wide dashboard (per-group role/term/commit rate, lane
     mix, replication in-flight, worst health verdict) refreshed in
     place every ``--watch`` seconds (Ctrl-C exits; ``--once`` prints a
-    single frame). Unreachable members render as rows, never drop."""
+    single frame; ``--json`` one machine-readable frame — the CI smoke
+    shape, parity with ``timeline --json``). Unreachable members
+    render as rows, never drop."""
     import time
 
-    from .utils.timeseries import render_top
+    from .utils.timeseries import render_top, top_payload
 
     rc = _bad_addresses(args.addresses)
     if rc:
@@ -676,7 +678,8 @@ def _top(args: argparse.Namespace) -> int:
             members, failed = asyncio.run(collect())
             if not members:
                 failures += 1
-                if args.once or failures >= 3:
+                if args.once or getattr(args, "json", False) \
+                        or failures >= 3:
                     print(f"copycat-tpu top: none of "
                           f"{len(args.addresses)} member(s) reachable "
                           f"({', '.join(args.addresses)})",
@@ -685,6 +688,13 @@ def _top(args: argparse.Namespace) -> int:
             else:
                 failures = 0
                 now = time.monotonic()
+                if getattr(args, "json", False):
+                    # --json implies one-shot: a single frame carries
+                    # no prior poll, so rates are null, never a
+                    # misleading 0.0
+                    payload, _ = top_payload(members, failed)
+                    print(json.dumps(payload, indent=2))
+                    return 0
                 frame, state = render_top(members, failed, prev,
                                           now - prev_t if prev else 0.0)
                 if args.once:
@@ -696,6 +706,107 @@ def _top(args: argparse.Namespace) -> int:
             time.sleep(args.watch)
     except KeyboardInterrupt:
         return 0
+
+
+def _profile_device(args: argparse.Namespace) -> int:
+    """``copycat-tpu profile --device <trace_dir>``: the device-plane
+    side of the one profiling entrypoint — summarize a captured xprof
+    trace directory (``utils/profiling.py``) into per-op totals. The
+    helper's actionable errors (no xplane files, no xprof package)
+    surface as one-line messages + exit 1, never tracebacks."""
+    from .utils.profiling import summarize_trace
+
+    try:
+        rows = summarize_trace(args.device, top=args.top)
+    except (FileNotFoundError, RuntimeError) as exc:
+        print(f"copycat-tpu profile: {exc}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps([{"op": op, "total_ms": round(ms, 3),
+                           "count": n} for op, ms, n in rows], indent=2))
+        return 0
+    print(f"{'op':<48} {'total_ms':>10} {'count':>7}")
+    for op, ms, n in rows:
+        print(f"{op:<48} {ms:>10.3f} {n:>7}")
+    return 0
+
+
+def _profile(args: argparse.Namespace) -> int:
+    """``copycat-tpu profile addr [addr...]``: fan out to every
+    process's ``/profile`` route and merge the folded wall stacks into
+    ONE cluster profile (docs/OBSERVABILITY.md "Profiling") — every
+    stack prefixed with its member identity, top-K frames ranked with
+    self/total percentages, the heaviest event-loop holds below.
+    Unreachable members (and members serving no ``/profile`` —
+    ``COPYCAT_PROFILE=0`` or a pre-profiler build) mark the merge
+    ``incomplete``, never dropped. ``--json`` emits the merge (the
+    ``--diff`` baseline shape); ``--diff saved.json`` ranks per-frame
+    self%% moves against a saved artifact. ``--device <trace_dir>``
+    routes to the xprof summary instead — host and device profiling
+    behind one verb."""
+    import time
+
+    from .utils import profiler as profiler_mod
+
+    if getattr(args, "device", None):
+        return _profile_device(args)
+    if not args.addresses:
+        print("copycat-tpu profile: give member stats address(es) for "
+              "a host profile, or --device <trace_dir> for a captured "
+              "device trace", file=sys.stderr)
+        return 2
+    rc = _bad_addresses(args.addresses)
+    if rc:
+        return rc
+    fetch_json = _fetch_json_fn()
+    path = "/profile"
+    if getattr(args, "last", None):
+        path += f"?since={time.time() - args.last}"
+
+    async def collect() -> tuple[dict, list]:
+        bodies = await asyncio.gather(*(fetch_json(a, path)
+                                        for a in args.addresses))
+        members: dict = {}
+        failed: list = []
+        for address, body in zip(args.addresses, bodies):
+            if body is None:
+                failed.append(address)
+            else:
+                members[address] = body
+        return members, failed
+
+    members, failed = asyncio.run(collect())
+    if not members:
+        print(f"copycat-tpu profile: none of {len(args.addresses)} "
+              f"member(s) reachable ({', '.join(args.addresses)})\n"
+              f"(are the servers running with --stats-port?)",
+              file=sys.stderr)
+        return 1
+    profile = profiler_mod.assemble_profile(members, failed_members=failed)
+    diff_rows = None
+    if getattr(args, "diff", None):
+        try:
+            with open(args.diff) as f:
+                baseline = json.load(f)
+        except (OSError, ValueError) as exc:
+            print(f"copycat-tpu profile: cannot read baseline "
+                  f"{args.diff}: {exc}", file=sys.stderr)
+            return 1
+        diff_rows = profiler_mod.diff_profiles(profile, baseline,
+                                               top=args.top)
+    if args.json:
+        out = dict(profile)
+        if diff_rows is not None:
+            out["diff"] = diff_rows
+        print(json.dumps(out, indent=2))
+        return 0
+    print(profiler_mod.render_profile(profile, top=args.top))
+    if diff_rows is not None:
+        print(f"diff vs {args.diff} (self% deltas, largest move first):")
+        for r in diff_rows:
+            print(f"  {r['frame']:<52} {r['baseline_self_pct']:>5.1f}% "
+                  f"-> {r['self_pct']:>5.1f}%  ({r['delta_pct']:+.1f})")
+    return 0
 
 
 def _cluster(args: argparse.Namespace) -> int:
@@ -886,6 +997,37 @@ def main(argv: list[str] | None = None) -> None:
                      help="print a single frame and exit (CI / "
                           "non-tty mode; rates need two polls, so a "
                           "single frame shows '-')")
+    top.add_argument("--json", action="store_true",
+                     help="emit one machine-readable frame and exit "
+                          "(parity with `timeline --json`; rates are "
+                          "null on a single poll)")
+
+    profile = sub.add_parser(
+        "profile", help="merged cluster wall-stack profile: fan out to "
+                        "every member's /profile, merge the folded "
+                        "stacks into one flame (per-member prefixes), "
+                        "rank top frames and event-loop holds; "
+                        "--device summarizes a captured xprof trace")
+    profile.add_argument("addresses", nargs="*", metavar="host:port",
+                         help="stats endpoints to merge; unreachable "
+                              "members mark the profile incomplete, "
+                              "never dropped (omit with --device)")
+    profile.add_argument("--last", type=float, default=None, metavar="N",
+                         help="window: merge the last N seconds "
+                              "(default: each member's full retention "
+                              "ring, COPYCAT_PROFILE_WINDOW_S)")
+    profile.add_argument("--top", type=int, default=20, metavar="K",
+                         help="frames ranked in the table (default 20)")
+    profile.add_argument("--json", action="store_true",
+                         help="emit the merged profile as JSON (the "
+                              "--diff baseline / CI artifact shape)")
+    profile.add_argument("--diff", default=None, metavar="BASELINE.json",
+                         help="rank per-frame self%% moves against a "
+                              "profile saved earlier with --json")
+    profile.add_argument("--device", default=None, metavar="TRACE_DIR",
+                         help="summarize a captured device trace "
+                              "directory (utils/profiling.py xprof "
+                              "helpers) instead of host profiling")
 
     cluster = sub.add_parser(
         "cluster", help="run/operate a multi-process deployment "
@@ -955,6 +1097,8 @@ def main(argv: list[str] | None = None) -> None:
         raise SystemExit(_timeline(args))
     if args.verb == "top":
         raise SystemExit(_top(args))
+    if args.verb == "profile":
+        raise SystemExit(_profile(args))
     if args.verb == "cluster":
         raise SystemExit(_cluster(args))
     if args.verb == "serve":

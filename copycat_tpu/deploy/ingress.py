@@ -45,7 +45,7 @@ from typing import Any, Callable
 from ..io.transport import Address, Connection, Transport, TransportError
 from ..protocol import messages as msg
 from ..protocol.operations import QueryConsistency
-from ..utils import knobs
+from ..utils import knobs, profiler
 from ..utils.managed import Managed
 from ..utils.metrics import MetricsRegistry
 from ..utils.scheduled import Scheduled, schedule_repeating
@@ -144,6 +144,13 @@ class IngressServer(Managed):
                                    metrics=m)
                        if knobs.get_bool("COPYCAT_SERIES") else None)
         self._series_timer: Scheduled | None = None
+        # Continuous profiling plane (docs/OBSERVABILITY.md
+        # "Profiling"): the proxy tier profiles too — the refcounted
+        # process-wide sampler, released in _do_close. No flight ring
+        # on this tier, so no stall-note callback; holds still surface
+        # via profile.hold_* and /profile. COPYCAT_PROFILE=0 -> None:
+        # no thread, no keys, no routes (A/B).
+        self.profiler = profiler.acquire(m, note_fn=None)
         # Same names/semantics as the server-side ingress phases
         # (docs/OBSERVABILITY.md) so per-tier attribution reads one
         # vocabulary; recorded for EVERY forward on this tier (its whole
@@ -176,6 +183,8 @@ class IngressServer(Managed):
         self._peer_connections.clear()
         self._sessions.clear()
         self._m_sessions.set(0)
+        profiler.release(self.profiler, self.metrics)
+        self.profiler = None
 
     # ------------------------------------------------------------------
     # client side: one handler set per accepted connection

@@ -41,7 +41,7 @@ import sys
 import time
 
 from ..server.stats import StatsListener, fetch_stats
-from ..utils import knobs
+from ..utils import knobs, profiler
 from ..utils.managed import Managed
 from ..utils.metrics import MetricsRegistry
 from ..utils.tasks import spawn
@@ -142,6 +142,11 @@ class Supervisor(Managed):
         self._m_health_checks = m.counter("deploy.health_checks")
         self._m_health_failures = m.counter("deploy.health_failures")
         self._m_kills = m.counter("deploy.kills")
+        # Continuous profiling plane (docs/OBSERVABILITY.md
+        # "Profiling"): the supervisor process profiles itself too —
+        # refcounted acquire, released in _do_close. No flight ring,
+        # so no stall-note callback. COPYCAT_PROFILE=0 -> None (A/B).
+        self.profiler = profiler.acquire(m, note_fn=None)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -202,6 +207,8 @@ class Supervisor(Managed):
         if self.control is not None:
             await self.control.close()
             self.control = None
+        profiler.release(self.profiler, self.metrics)
+        self.profiler = None
 
     # ------------------------------------------------------------------
     # child launch + crash loop

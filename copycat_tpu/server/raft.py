@@ -41,7 +41,7 @@ from ..io.serializer import Serializer
 from ..io.transport import Address, Connection, Transport, TransportError
 from ..protocol import messages as msg
 from ..protocol.operations import QueryConsistency
-from ..utils import knobs
+from ..utils import knobs, profiler
 from ..utils.health import BlackBox, HealthMonitor
 from ..utils.timeseries import SeriesStore
 from ..utils.managed import Managed
@@ -180,6 +180,15 @@ class RaftServer(Managed):
         if self._health_enabled and knobs.get_bool("COPYCAT_SERIES"):
             self.series = SeriesStore(node=self.address, role="member",
                                       metrics=self._metrics)
+        # Continuous profiling plane (docs/OBSERVABILITY.md
+        # "Profiling"): a refcounted process-wide wall-stack sampler +
+        # event-loop hold attribution — acquired BEFORE the monitor
+        # (it probes `profiler` at construction to decide whether the
+        # loop_stall detector runs) and released in _do_close.
+        # COPYCAT_PROFILE=0 makes acquire a no-op returning None: no
+        # sampler thread, no profile.* keys, no /profile routes (A/B).
+        self.profiler = profiler.acquire(self._metrics,
+                                         note_fn=self.health_note)
         if self._health_enabled:
             if self.storage.directory:
                 self.blackbox = BlackBox(os.path.join(
@@ -290,6 +299,11 @@ class RaftServer(Managed):
         self._peer_connections.clear()
         if self.blackbox is not None:
             self.blackbox.close()
+        # last release per process stops the sampler + unpatches the
+        # loop; _cancel_timers (the SIGKILL-shaped stop) deliberately
+        # does NOT release — a crash doesn't run destructors either
+        profiler.release(self.profiler, self._metrics)
+        self.profiler = None
 
     def _cancel_timers(self) -> None:
         # crash_server (testing/nemesis.py) calls this for its

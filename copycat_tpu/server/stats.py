@@ -78,6 +78,27 @@ def _series_query(query: str) -> tuple[float | None, list[str] | None]:
     return since, names
 
 
+def _profile_query(query: str) -> tuple[float | None, int | None]:
+    """Parse ``?since=<wall seconds>&top=<K>`` for the ``/profile``
+    routes; malformed values degrade to the unfiltered window rather
+    than a 500 (observability never wounds)."""
+    since: float | None = None
+    top: int | None = None
+    for part in query.split("&"):
+        key, _, value = part.partition("=")
+        if key == "since" and value:
+            try:
+                since = float(value)
+            except ValueError:
+                pass
+        elif key == "top" and value:
+            try:
+                top = max(1, int(value))
+            except ValueError:
+                pass
+    return since, top
+
+
 class StatsListener:
     """Serves one RaftServer's observability surface over HTTP.
 
@@ -260,6 +281,21 @@ class StatsListener:
                         .encode(), "application/json")
             return (store.render_text(since=since, names=names).encode(),
                     "text/plain")
+        prof = getattr(self._raft, "profiler", None)
+        if path in ("/profile", "/profile.txt") and prof is not None:
+            # the continuous profiling plane (utils/profiler.py):
+            # folded wall stacks + loop holds, ?since=<wall s> windows,
+            # ?top=<K> truncation — what `copycat-tpu profile` fans out
+            # and merges. /profile.txt is pure flamegraph.pl collapsed
+            # lines. COPYCAT_PROFILE=0 falls through to the
+            # unknown-route error: ABSENT, not empty (the A/B surface).
+            since, top = _profile_query(query)
+            if path == "/profile":
+                payload = prof.payload(since=since, top=top)
+                payload["node"] = str(self._raft.address)
+                return (json.dumps(payload).encode(), "application/json")
+            return (prof.render_text(since=since, top=top).encode(),
+                    "text/plain")
         if path in ("/", "/stats", "/stats.json"):
             return json.dumps(self._raft.stats_snapshot()).encode(), \
                 "application/json"
@@ -267,6 +303,8 @@ class StatsListener:
                   "/traces.txt", "/traces/<id>", "/flight", "/flight.txt"]
         if store is not None:
             routes += ["/series", "/series.txt"]
+        if prof is not None:
+            routes += ["/profile", "/profile.txt"]
         return (json.dumps({"error": f"unknown path {path}",
                             "routes": routes}).encode(),
                 "application/json")

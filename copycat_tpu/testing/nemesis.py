@@ -161,6 +161,51 @@ class SlowDiskNemesis:
         return getattr(groups, "telemetry", None)
 
 
+def _nemesis_synchronous_hold(delay_s: float) -> None:
+    """The named blocking call :class:`LoopHoldNemesis` schedules — a
+    module-level function ON PURPOSE: the profiling plane's ground
+    truth is that the folded leaf frame NAMES the blocking code, and a
+    lambda/closure would fold to an anonymous frame."""
+    import time as _time
+
+    _time.sleep(delay_s)
+
+
+class LoopHoldNemesis:
+    """Inject a synchronous event-loop hold: the blocking-call fault
+    the profiling plane's hold attribution and the ``loop_stall``
+    detector exist to catch (the runtime sibling of the copycheck
+    loop-blocking rule — this one actually happens). Schedules
+    :func:`_nemesis_synchronous_hold` straight onto the running loop,
+    so every co-resident server's loop freezes for ``delay_s`` — the
+    same shape as an accidental ``time.sleep`` / cold ``jit`` compile /
+    synchronous disk read on the loop."""
+
+    def __init__(self, server, delay_s: float = 0.15) -> None:
+        self._server = server
+        self.delay_s = delay_s
+        self.injected = 0
+
+    def inject(self) -> None:
+        """Schedule one hold on the running loop (call from a
+        coroutine; the hold lands on the next loop turn)."""
+        import asyncio as _asyncio
+
+        _asyncio.get_running_loop().call_soon(
+            _nemesis_synchronous_hold, self.delay_s)
+        self.injected += 1
+        hub = self._hub()
+        if hub is not None:
+            hub.flight.record("fault", 0, fault="loop_hold",
+                              delay_s=self.delay_s)
+
+    def _hub(self):
+        machine = getattr(self._server, "state_machine", None)
+        engine = getattr(machine, "_engine", None)
+        groups = getattr(engine, "_groups", None)
+        return getattr(groups, "telemetry", None)
+
+
 class StorageNemesis:
     """Crash/torn-write fault injection over one server's storage
     directory (the host-plane sibling of :class:`Nemesis`): mutates the

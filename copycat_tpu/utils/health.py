@@ -455,14 +455,67 @@ class SloBurnDetector(Detector):
         return self._finding(sev, "; ".join(reasons), group, **evidence)
 
 
+class LoopStallDetector(Detector):
+    """Event-loop holds over ``COPYCAT_PROFILE_HOLD_MS``, judged on the
+    per-window max hold with the holding frame as evidence — the
+    profiling plane's runtime complement to the copycheck loop-blocking
+    rule. A hold at the threshold grades ``warn``; 5x the threshold
+    grades ``critical`` (a 500ms+ hold under the default freezes
+    heartbeats and elections alike).
+
+    Reads the profiler's bounded hold ring over the evidence window's
+    actual span (the history deque's timestamps), so one old hold ages
+    out of the verdict exactly like every delta detector's evidence.
+    Constructed only when the host carries a profiler
+    (``COPYCAT_PROFILE=1``), keeping the off-plane detector set — and
+    every ``health.*`` key — bit-identical.
+
+    In-process multi-server clusters share one process-wide profiler,
+    so every co-resident member's detector sees the same holds: honest
+    for a process-level property (the loop and the GIL are shared)."""
+
+    name = "loop_stall"
+    scope = "server"
+
+    def __init__(self, server: Any) -> None:
+        self.server = server
+        self.hold_ms = max(1.0,
+                           knobs.get_float("COPYCAT_PROFILE_HOLD_MS"))
+
+    def evaluate(self, history, group):
+        prof = getattr(self.server, "profiler", None)
+        if prof is None:
+            return self._finding(OK, "", group)
+        lookback = 30.0
+        if len(history) >= 2:
+            lookback = max(1.0, history[-1][0] - history[0][0])
+        holds = prof.holds_since(time.time() - lookback)
+        if not holds:
+            return self._finding(OK, "", group)
+        worst_hold = max(holds, key=lambda h: h["ms"])
+        sev = (CRITICAL if worst_hold["ms"] >= 5 * self.hold_ms
+               else WARN)
+        return self._finding(
+            sev,
+            f"event loop held {worst_hold['ms']:.0f}ms by "
+            f"{worst_hold['frame']} ({len(holds)} hold(s) >= "
+            f"{self.hold_ms:.0f}ms in {lookback:.0f}s)",
+            group,
+            max_hold_ms=worst_hold["ms"],
+            frames=[h["frame"] for h in holds[-5:]],
+            stack=worst_hold.get("stack", ""))
+
+
 GROUP_DETECTORS = (LeaderChurnDetector, CommitStallDetector,
                    WindowCollapseDetector, FsyncSpikeDetector,
                    SessionExpiryDetector, SnapshotFailureDetector)
 SERVER_DETECTORS = (IngressBacklogDetector,)
 #: the catalog of detector names (docs/OBSERVABILITY.md) — slo_burn
-#: constructs with the host server, so it rides neither class tuple
+#: and loop_stall construct with the host server, so they ride
+#: neither class tuple
 DETECTOR_NAMES = tuple(d.name for d in GROUP_DETECTORS
-                       + SERVER_DETECTORS) + (SloBurnDetector.name,)
+                       + SERVER_DETECTORS) \
+    + (SloBurnDetector.name, LoopStallDetector.name)
 
 
 # ---------------------------------------------------------------------------
@@ -491,6 +544,12 @@ class HealthMonitor:
             # keeps the detector set (and every health.* key)
             # bit-identical to the pre-series plane
             self.server_detectors.append(SloBurnDetector(server))
+        if getattr(server, "profiler", None) is not None:
+            # loop_stall judges the profiler's hold ring, so it exists
+            # exactly when the profiling plane does — COPYCAT_PROFILE=0
+            # keeps the detector set (and every health.* key)
+            # bit-identical to the pre-profiler plane
+            self.server_detectors.append(LoopStallDetector(server))
         self._history: dict[int, deque] = {}
         self._server_history: deque = deque(maxlen=self.window)
         self._timer: Scheduled | None = None
@@ -840,6 +899,27 @@ def assemble_doctor_report(members: dict[str, dict],
     rows = _member_findings(members)
     causes: list[dict] = []
 
+    # stall notes from the profiling plane: recent loop_stall flight /
+    # black-box events per member, heaviest hold first — the "which
+    # code held the loop" evidence the commit_stall / fsync_spike
+    # causes cite below when the notes fall inside the report's
+    # lookback (~2 profile windows)
+    stall_notes: dict[str, list[dict]] = {}
+    now = time.time()
+    for key, payload in sorted(members.items()):
+        member = _member_label(key, payload)
+        flight = (payload or {}).get("flight") or {}
+        events = list(flight.get("events", ()))
+        events += ((flight.get("blackbox") or {}).get("events") or [])
+        notes = [e for e in events
+                 if e.get("kind") == "loop_stall"
+                 and not e.get("recovered")
+                 and isinstance(e.get("t"), (int, float))
+                 and now - e["t"] <= 240.0]
+        if notes:
+            notes.sort(key=lambda e: -float(e.get("hold_ms", 0.0)))
+            stall_notes[member] = notes
+
     # 1. invariant violations: a safety counter that moved outranks any
     #    performance symptom
     for member, count in sorted(_invariant_counts(members).items()):
@@ -977,7 +1057,12 @@ def assemble_doctor_report(members: dict[str, dict],
                       "slo_burn":
                       "SLO error budget burning faster than the "
                       "objective allows — see the retained window "
-                      "(doctor --last N / copycat-tpu timeline)"
+                      "(doctor --last N / copycat-tpu timeline)",
+                      "loop_stall":
+                      "synchronous code holding the event loop — the "
+                      "cited frame blocked heartbeats, elections and "
+                      "appends alike (copycat-tpu profile for the "
+                      "full flame)"
                       }.get(r["detector"], r["detector"]),
             "members": [r["member"]], "detectors": [r["detector"]],
         })
@@ -1000,6 +1085,22 @@ def assemble_doctor_report(members: dict[str, dict],
                      "the story is ungraded, not healthy",
             "members": [member], "detectors": ["health_plane"],
         })
+
+    # the profiling plane's citation: a commit stall or fsync spike
+    # whose members carry stall notes inside the lookback gets the top
+    # holding frames attached — symptom, disk and the blocking CODE in
+    # one cause row (what no single detector can say alone)
+    for c in causes:
+        if not set(c["detectors"]) & {"commit_stall", "fsync_spike"}:
+            continue
+        frames = []
+        for m in c["members"]:
+            for note in stall_notes.get(m, ())[:3]:
+                frames.append({"member": m,
+                               "frame": note.get("frame", "?"),
+                               "hold_ms": note.get("hold_ms")})
+        if frames:
+            c["profile_frames"] = frames
 
     causes.sort(key=lambda c: -_RANK.get(c["severity"], 0))
     verdict = worst(s for s in statuses.values() if s in _RANK)
@@ -1069,6 +1170,12 @@ def render_doctor_report(report: dict) -> str:
         g = f" [group {c['group']}]" if c.get("group") is not None else ""
         lines.append(f"{i}. {c['severity'].upper()}{g} {c['symptom']}")
         lines.append(f"   cause: {c['cause']}")
+        for f in c.get("profile_frames", ()):
+            hold = f.get("hold_ms")
+            held = f" ({hold:g} ms)" if isinstance(hold, (int, float)) \
+                else ""
+            lines.append(f"   held by: {f['member']}: "
+                         f"{f['frame']}{held}")
         for note in c.get("retrospect", ()):
             lines.append(f"   onset: {note}")
     for t in report.get("slowest_traces", ()):

@@ -236,6 +236,28 @@ _knob("COPYCAT_SLO_AVAIL", "float", None,
           "frozen behind its log tail; the `slo_burn` detector grades "
           "the error-budget burn rate over the retained window",
       section="observability")
+_knob("COPYCAT_PROFILE", "bool", True,
+      "`0` disables the continuous profiling plane (the process-wide "
+      "wall-stack sampler, event-loop hold attribution, the "
+      "`/profile` routes, the `profile.*` family and the `loop_stall` "
+      "detector) — the A/B knob restoring the pre-profiler process "
+      "bit-identically: no sampler thread, no keys, no routes",
+      section="observability")
+_knob("COPYCAT_PROFILE_HZ", "float", 19.0,
+      "wall-stack samples per second (`utils/profiler.py`; "
+      "deliberately off-cadence from the 1 Hz health/series timers so "
+      "samples don't alias the periodic work they profile)",
+      section="observability")
+_knob("COPYCAT_PROFILE_HOLD_MS", "float", 100.0,
+      "event-loop hold threshold in ms: a callback/task step holding "
+      "the loop at least this long records a hold (the `loop_stall` "
+      "evidence, a flight-recorder stall note, and the "
+      "`profile.hold_*` series); 5x grades `critical`",
+      section="observability")
+_knob("COPYCAT_PROFILE_WINDOW_S", "int", 120,
+      "seconds of folded-stack aggregate retained in the profile ring "
+      "before oldest-first eviction — the `/profile` lookback "
+      "(`?since=` windows within it)", section="observability")
 
 # --- client ----------------------------------------------------------------
 _knob("COPYCAT_CLIENT_FOLLOWER_READS", "bool", True,

@@ -306,7 +306,7 @@ def resample(samples: list[dict], key: str, t0: float, t1: float,
 #: flight/black-box kinds the timeline renders as event marks (anything
 #: else — raw telemetry notes — would drown the marks that matter)
 _EVENT_KINDS = ("fault", "boot", "health", "invariant_violation",
-                "slow_trace")
+                "slow_trace", "loop_stall")
 
 
 def _event_detail(ev: dict) -> str:
@@ -554,6 +554,75 @@ def render_top(members: dict[str, dict], failed: Iterable[str] = (),
     return "\n".join([banner, header] + rows), state
 
 
+def top_payload(members: dict[str, dict], failed: Iterable[str] = (),
+                prev: dict | None = None, dt: float = 0.0
+                ) -> tuple[dict, dict]:
+    """The machine-readable sibling of :func:`render_top` (parity with
+    ``timeline --json``): one frame as JSON — per-member role/term/
+    health/commit-rate plus per-group cursors — for the CI smoke and
+    any scripted poll, so nobody scrapes the text dashboard. Returns
+    ``(payload, state)``; rates need two polls, so a first frame (no
+    ``prev``) carries ``commit_rate: null``, never a misleading 0.0.
+    Unreachable members land in ``failed`` as rows of their own —
+    reported, never dropped."""
+    from ..cli import _flatten_numeric  # the stats flattening, one home
+
+    statuses: list[str] = []
+    state: dict = {}
+    out_members: dict = {}
+    for addr in sorted(members):
+        payload = members[addr] or {}
+        stats = payload.get("stats") or {}
+        health = payload.get("health") or {}
+        status = health.get("status", "unknown")
+        statuses.append(status)
+        flat = _flatten_numeric(stats)
+        state[addr] = flat
+        mprev = (prev or {}).get(addr)
+        node = str(stats.get("node", addr))
+        groups = stats.get("groups") or {}
+        have_rates = bool(mprev) and dt > 0
+        row: dict = {
+            "role": stats.get("role", "?"),
+            "term": stats.get("term", 0),
+            "health": status,
+            "inflight": sum(v for k, v in flat.items()
+                            if k.startswith("raft.repl.windows_inflight")),
+            "commit_rate": round(_rate(flat, mprev,
+                                       "raft.raft_commit_index", dt), 3)
+            if have_rates else None,
+            "groups": {},
+        }
+        if groups:
+            row["groups_led"] = sum(1 for g in groups.values()
+                                    if g.get("role") == "leader")
+        for gid in sorted(groups, key=lambda s: int(s)):
+            g = groups[gid]
+            row["groups"][gid] = {
+                "role": g.get("role", "?"),
+                "term": g.get("term", 0),
+                "commit_index": g.get("commit_index", 0),
+                "lag": (g.get("log_last_index", 0)
+                        - g.get("commit_index", 0)),
+                "commit_rate": round(_rate(flat, mprev,
+                                           f"groups.{gid}.commit_index",
+                                           dt), 3)
+                if have_rates else None,
+            }
+        out_members[node] = row
+    failed_rows = sorted(set(failed))
+    statuses += ["unreachable"] * len(failed_rows)
+    verdict = "unknown"
+    for s in ("critical", "warn", "unreachable", "ok"):
+        if s in statuses:
+            verdict = s
+            break
+    return ({"now": round(time.time(), 3),
+             "members": out_members,
+             "failed": failed_rows,
+             "worst_health": verdict}, state)
+
+
 # ---------------------------------------------------------------------------
 # retrospective onset detection (`doctor --last N`)
 # ---------------------------------------------------------------------------
@@ -603,6 +672,6 @@ def series_onsets(series_payload: dict, prefixes: Iterable[str],
 
 __all__ = [
     "SeriesStore", "assemble_timeline", "render_timeline", "render_top",
-    "series_onsets", "series_sort_key", "sparkline", "flatten_registry",
-    "resample", "DEFAULT_TIMELINE_PREFIXES",
+    "top_payload", "series_onsets", "series_sort_key", "sparkline",
+    "flatten_registry", "resample", "DEFAULT_TIMELINE_PREFIXES",
 ]

@@ -195,7 +195,9 @@ class Tracer:
         if as_json:
             return json.dumps([
                 {"trace": trace_id, "total_ms": round(total, 3),
-                 "spans": [s.as_dict() for s in spans]}
+                 "spans": [s.as_dict() for s in spans],
+                 **({"profile": prof} if (prof := self._profile_window(
+                     spans)) else {})}
                 for trace_id, total, spans in slow])
         lines = []
         for trace_id, total, spans in slow:
@@ -206,7 +208,34 @@ class Tracer:
                         if s.meta else "")
                 lines.append(f"  +{(s.start - t0) * 1e3:8.3f} ms "
                              f"{s.name:<16} {s.duration_ms:8.3f} ms{meta}")
+            prof = self._profile_window(spans)
+            if prof:
+                top = prof["stacks"][0]
+                lines.append(f"  profile: {prof['samples']} sample(s) in "
+                             f"the window, hottest "
+                             f"{top['stack'].rsplit(';', 1)[-1]} "
+                             f"(x{top['count']})")
         return "\n".join(lines) if lines else "(no traces recorded)"
+
+    @staticmethod
+    def _profile_window(spans: list) -> dict | None:
+        """The continuous profiler's top stacks over this trace's wall
+        window (utils/profiler.py) — a slow trace names the code the
+        process was ACTUALLY running while it was slow, not just its
+        own spans. Empty/absent when the plane is off or no sample
+        landed in the window."""
+        from . import profiler  # lazy: tracing must not require the plane
+
+        prof = profiler.PROFILER
+        if prof is None:
+            return None
+        try:
+            w0 = min(s.start for s in spans) + _WALL_OFFSET
+            w1 = max(s.end for s in spans) + _WALL_OFFSET
+            window = prof.window_top(w0, w1, top=3)
+            return window if window["samples"] else None
+        except Exception:  # noqa: BLE001 - never wound the dump
+            return None
 
     def clear(self) -> None:
         self._traces.clear()

@@ -359,3 +359,42 @@ def test_doctor_json_report_shape():
     assert "slow disk (fsync spike)" in stall["cause"]
     assert "m2:2" in stall["members"]
     assert json.loads(json.dumps(report)) == report  # JSON-able artifact
+
+def test_doctor_cites_holding_frames_on_overlapping_stalls():
+    """A commit_stall whose member also carries recent ``loop_stall``
+    flight notes gets the holding frames attached as evidence — the
+    doctor's bridge from "commits stalled" to "THIS code held the
+    loop" — and the rendering prints the ``held by:`` rows."""
+    import time as _time
+
+    note = {"seq": 1, "t": round(_time.time(), 3), "round": 0,
+            "kind": "loop_stall", "hold_ms": 180.0,
+            "frame": "nemesis._nemesis_synchronous_hold",
+            "callback": "Handle", "stack": "MainThread;nemesis."
+            "_nemesis_synchronous_hold"}
+    stale = dict(note, seq=2, t=round(_time.time() - 9_000, 3),
+                 frame="ancient.hold")
+    members = {
+        "m1:1": {"health": {"status": "critical", "node": "m1:1",
+                            "detectors": {
+            "commit_stall": {"status": "critical", "groups": {
+                "0": {"status": "critical",
+                      "reason": "commit stalled 3.0s at index 7 with 4 "
+                                "uncommitted entries (and growing)",
+                      "evidence": {"commit_index": [7, 7]}}}}}},
+         "flight": {"events": [note, stale]}},
+    }
+    report = assemble_doctor_report(members)
+    stall = _causes(report, "commit_stall")[0]
+    frames = stall["profile_frames"]
+    assert frames == [{"member": "m1:1",
+                       "frame": "nemesis._nemesis_synchronous_hold",
+                       "hold_ms": 180.0}]  # the stale note aged out
+    out = render_doctor_report(report)
+    assert ("held by: m1:1: nemesis._nemesis_synchronous_hold "
+            "(180 ms)") in out
+    # no notes -> no key: the report shape without the profiling
+    # plane is unchanged
+    del members["m1:1"]["flight"]
+    report2 = assemble_doctor_report(members)
+    assert "profile_frames" not in _causes(report2, "commit_stall")[0]

@@ -1,0 +1,327 @@
+"""The continuous profiling plane against live servers
+(docs/OBSERVABILITY.md "Profiling"): hold attribution naming the
+blocking frame, the ``/profile`` routes, the ``COPYCAT_PROFILE=0``
+off-plane differential, and the nemesis ground truth — an injected
+synchronous hold named by BOTH the ``loop_stall`` finding and the
+merged cluster profile, over the real wire."""
+
+import asyncio
+import json
+import threading
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from copycat_tpu import cli  # noqa: E402
+from copycat_tpu.server.log import Storage, StorageLevel  # noqa: E402
+from copycat_tpu.server.stats import StatsListener, fetch_stats  # noqa: E402
+from copycat_tpu.testing.nemesis import LoopHoldNemesis  # noqa: E402
+from copycat_tpu.utils import profiler  # noqa: E402
+from copycat_tpu.utils.timeseries import assemble_timeline  # noqa: E402
+
+from helpers import arun  # noqa: E402
+from raft_fixtures import Put, create_cluster  # noqa: E402
+
+
+def _ns(**kw):
+    return type("A", (), kw)()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_profiler():
+    """Crash-nemesis tests elsewhere leak a refcounted profiler into
+    the process ON PURPOSE (SIGKILL semantics: ``_cancel_timers``
+    never releases) — start every test here from the unpatched shape
+    so knob monkeypatching and thread-count deltas mean something."""
+    with profiler._ACQUIRE_LOCK:
+        leaked, profiler.PROFILER = profiler.PROFILER, None
+    if leaked is not None:
+        leaked.stop()
+    yield
+
+
+def _sampler_threads() -> int:
+    return sum(1 for t in threading.enumerate()
+               if t.name == "copycat-profiler")
+
+
+# ---------------------------------------------------------------------------
+# the profiler itself: sampling + hold attribution, no cluster needed
+# ---------------------------------------------------------------------------
+
+
+def test_hold_attribution_names_the_blocking_frame(monkeypatch):
+    """A synchronous callback over the threshold records a hold whose
+    folded stack ends in the CALLBACK's own frame (a sample lands
+    inside any 60ms block at 97 Hz), notes fire, and release restores
+    the unpatched loop."""
+    monkeypatch.setenv("COPYCAT_PROFILE_HZ", "97")
+    monkeypatch.setenv("COPYCAT_PROFILE_HOLD_MS", "20")
+    import asyncio.events as aio_events
+
+    unpatched = aio_events.Handle._run
+    notes = []
+    prof = profiler.acquire()
+    assert prof is not None and prof.running
+    # registering a view late still creates the gauge keys + notes
+    from copycat_tpu.utils.metrics import MetricsRegistry
+    reg = MetricsRegistry()
+    prof.register_view(reg, lambda kind, **f: notes.append((kind, f)))
+
+    def sync_block():
+        import time
+        time.sleep(0.06)
+
+    async def run():
+        asyncio.get_running_loop().call_soon(sync_block)
+        await asyncio.sleep(0.25)
+
+    asyncio.run(run())
+    payload = prof.payload()
+    assert payload["counters"]["samples"] > 0
+    assert payload["counters"]["holds"] >= 1
+    hold = max(payload["holds"], key=lambda h: h["ms"])
+    assert hold["ms"] >= 20
+    assert hold["frame"].endswith(".sync_block")
+    assert hold["stack"].split(";")[-1] == hold["frame"]
+    assert any(k == "loop_stall" and f["frame"].endswith(".sync_block")
+               for k, f in notes)
+    # gauges refreshed by the hold path
+    snap = reg.snapshot()
+    assert snap["profile.holds"] >= 1
+    assert snap["profile.hold_max_ms"] >= 20
+    # text rendering is pure collapsed lines
+    line = prof.render_text(top=1).strip()
+    assert line.rsplit(" ", 1)[1].isdigit()
+    profiler.release(prof, reg)
+    assert profiler.PROFILER is None
+    assert aio_events.Handle._run is unpatched
+
+
+def test_frame_table_merge_and_diff():
+    """The pure aggregation side: self/total percentages (total
+    deduped per stack, so recursion can't exceed 100%), the member-
+    prefixed cluster merge with incomplete-never-dropped semantics,
+    and the self% diff against a saved baseline."""
+    stacks = [("main;a.f;b.g", 6), ("main;a.f", 3), ("main;c.h;a.f", 1)]
+    table = profiler.frame_table(stacks, top=10, skip=1)
+    by_frame = {r["frame"]: r for r in table}
+    assert by_frame["a.f"]["self"] == 4      # leaf in rows 2 + 3
+    assert by_frame["a.f"]["total"] == 10    # appears in every stack
+    assert by_frame["a.f"]["total_pct"] == 100.0
+    assert by_frame["b.g"]["self"] == 6
+    # merge: member prefixes, unreachable + knob-off reasons, holds
+    pay = {"node": "m1", "stacks": [{"stack": "main;a.f", "count": 2}],
+           "holds": [{"t": 1.0, "ms": 50.0, "frame": "a.f",
+                      "stack": "main;a.f"}]}
+    merged = profiler.assemble_profile(
+        {"m1:1": pay, "m2:2": {"error": "unknown path /profile"}},
+        failed_members=["m3:3"])
+    assert merged["incomplete"] is True
+    assert any("m3:3 unreachable" in w for w in merged["incomplete_why"])
+    assert any("m2:2" in w and "COPYCAT_PROFILE=0" in w
+               for w in merged["incomplete_why"])
+    assert merged["stacks"] == [{"stack": "m1;main;a.f", "count": 2}]
+    assert merged["contributed"] == {"m1": 2, "m2:2": 0}
+    assert merged["holds"][0]["member"] == "m1"
+    text = profiler.render_profile(merged, top=5)
+    assert "INCOMPLETE" in text and "a.f" in text
+    # diff: per-frame self% move vs the saved artifact shape
+    base = {"stacks": [{"stack": "m1;main;a.f", "count": 1},
+                       {"stack": "m1;main;b.g", "count": 1}]}
+    rows = profiler.diff_profiles(merged, base, top=10)
+    moves = {r["frame"]: r["delta_pct"] for r in rows}
+    assert moves["a.f"] == 50.0   # 100% now vs 50% in the baseline
+    assert moves["b.g"] == -50.0
+
+
+# ---------------------------------------------------------------------------
+# the exposition: /profile routes + the off-knob A/B differential
+# ---------------------------------------------------------------------------
+
+
+def test_profile_route_serves_windowed_stacks(monkeypatch):
+    monkeypatch.setenv("COPYCAT_PROFILE_HZ", "53")
+
+    async def run():
+        cluster = await create_cluster(1)
+        try:
+            server = cluster.servers[0]
+            assert server.profiler is not None
+            client = await cluster.client()
+            await client.submit(Put(key="k", value=1))
+            await asyncio.sleep(0.25)
+            listener = await StatsListener(server, port=0).open()
+            try:
+                addr = f"127.0.0.1:{listener.port}"
+                p = json.loads(await fetch_stats(addr, "/profile"))
+                assert p["node"] == str(server.address)
+                assert p["stacks"] and p["window_samples"] > 0
+                # every folded stack leads with a thread name
+                assert all(";" in r["stack"] for r in p["stacks"])
+                topped = json.loads(await fetch_stats(
+                    addr, "/profile?top=1"))
+                assert len(topped["stacks"]) == 1
+                assert topped["stacks"][0] == p["stacks"][0]
+                # ?since= windows on wall time (the /series model);
+                # a future cutoff leaves nothing
+                future = json.loads(await fetch_stats(
+                    addr, f"/profile?since={p['now'] + 60}"))
+                assert future["stacks"] == []
+                # malformed query degrades, never 500s
+                degraded = json.loads(await fetch_stats(
+                    addr, "/profile?since=nope&top=x"))
+                assert degraded["stacks"]
+                text = (await fetch_stats(addr, "/profile.txt")).decode()
+                first = text.splitlines()[0]
+                assert first.rsplit(" ", 1)[1].isdigit()
+                unknown = json.loads(await fetch_stats(addr, "/nope"))
+                assert "/profile" in unknown["routes"]
+                assert "/profile.txt" in unknown["routes"]
+            finally:
+                await listener.close()
+        finally:
+            await cluster.close()
+
+    arun(run(), timeout=120)
+
+
+def test_profile_off_knob_removes_the_plane(monkeypatch):
+    """COPYCAT_PROFILE=0 differential: no sampler thread, no /profile
+    route, no profile.* registry keys, no loop_stall detector — the
+    registry key set, route listing and thread set match the
+    pre-profiler process exactly (the bit-identity A/B the plane is
+    gated on)."""
+
+    async def snapshot_keys():
+        samplers_before = _sampler_threads()
+        cluster = await create_cluster(1)
+        try:
+            server = cluster.servers[0]
+            client = await cluster.client()
+            await client.submit(Put(key="k", value=1))
+            server.health.tick()
+            listener = await StatsListener(server, port=0).open()
+            try:
+                addr = f"127.0.0.1:{listener.port}"
+                profile_body = json.loads(
+                    await fetch_stats(addr, "/profile"))
+                unknown = json.loads(await fetch_stats(addr, "/nope"))
+                snap = server.stats_snapshot()["raft"]
+                detectors = set(server.health.tick()["detectors"])
+                # sampler threads created by THIS boot (delta, so a
+                # leak from an unrelated earlier test can't bleed in)
+                new_samplers = _sampler_threads() - samplers_before
+                return (server.profiler, profile_body,
+                        unknown["routes"], set(snap), detectors,
+                        new_samplers)
+            finally:
+                await listener.close()
+        finally:
+            await cluster.close()
+
+    monkeypatch.setenv("COPYCAT_PROFILE", "0")
+    prof_off, body_off, routes_off, keys_off, det_off, threads_off = \
+        arun(snapshot_keys(), timeout=120)
+    assert prof_off is None
+    assert threads_off == 0
+    # /profile is ABSENT, not empty: the unknown-route error, unlisted
+    assert "error" in body_off and "/profile" not in routes_off
+    assert not any(k.startswith("profile.") for k in keys_off)
+
+    monkeypatch.setenv("COPYCAT_PROFILE", "1")
+    prof_on, body_on, routes_on, keys_on, det_on, threads_on = arun(
+        snapshot_keys(), timeout=120)
+    assert prof_on is not None
+    assert threads_on == 1
+    assert "stacks" in body_on and "/profile" in routes_on
+    # the on-plane adds EXACTLY the profile.* family and the
+    # loop_stall detector gauge; everything else is bit-identical
+    assert keys_on - keys_off == {
+        "profile.samples", "profile.holds", "profile.hold_max_ms",
+        "profile.hold_ms", "profile.overhead_ms",
+        "health.detector_status{detector=loop_stall}"}
+    assert det_on - det_off == {"loop_stall"}
+
+
+# ---------------------------------------------------------------------------
+# the acceptance ground truth: nemesis hold -> finding + merged flame
+# ---------------------------------------------------------------------------
+
+
+def test_nemesis_loop_hold_named_by_finding_and_merged_profile(
+        monkeypatch, tmp_path, capsys):
+    """The acceptance differential, over the real wire: an injected
+    synchronous blocking call on a 3-member cluster is named — by
+    frame — in the ``loop_stall`` health finding, in the merged
+    cluster profile's top frames AND heaviest hold, and as a timeline
+    event mark."""
+    monkeypatch.setenv("COPYCAT_PROFILE_HZ", "97")
+    monkeypatch.setenv("COPYCAT_PROFILE_HOLD_MS", "30")
+
+    async def run():
+        cluster = await create_cluster(
+            3, storage_factory=lambda i: Storage(
+                StorageLevel.DISK, str(tmp_path / str(i)),
+                max_entries_per_segment=64))
+        listeners = []
+        try:
+            client = await cluster.client()
+            await client.submit(Put(key="k", value=1))
+            for s in cluster.servers:
+                listeners.append(await StatsListener(s, port=0).open())
+            addrs = [f"127.0.0.1:{ln.port}" for ln in listeners]
+            # the injection: a NAMED module-level synchronous call on
+            # the shared loop (97 Hz puts ~11 samples inside each
+            # 120ms hold, so attribution reads a real sampled stack)
+            nemesis = LoopHoldNemesis(cluster.servers[0], delay_s=0.12)
+            for _ in range(3):
+                nemesis.inject()
+                await asyncio.sleep(0.15)
+            # the finding: two ticks (delta detectors need history)
+            leader = cluster.leader
+            leader.health.tick()
+            await asyncio.sleep(0.05)
+            verdict = leader.health.tick()
+            stall = verdict["detectors"]["loop_stall"]["groups"][
+                "server"]
+            assert stall["status"] in ("warn", "critical")
+            assert "nemesis._nemesis_synchronous_hold" in \
+                stall["reason"]
+            # the merged cluster profile, over the wire via the CLI
+            rc = await asyncio.to_thread(cli._profile, _ns(
+                addresses=addrs, last=None, top=10, json=True,
+                diff=None, device=None))
+            assert rc == 0
+            profile = json.loads(capsys.readouterr().out)
+            assert profile["incomplete"] is False
+            assert len(profile["members"]) == 3
+            assert all(profile["contributed"][m] > 0
+                       for m in profile["members"])
+            # ...the heaviest hold names the injected frame...
+            assert profile["holds"][0]["frame"] == \
+                "nemesis._nemesis_synchronous_hold"
+            # ...and so do the top folded frames of the merged flame
+            table = profiler.frame_table(
+                [(s["stack"], s["count"]) for s in profile["stacks"]],
+                top=10, skip=2)
+            assert "nemesis._nemesis_synchronous_hold" in \
+                [r["frame"] for r in table]
+            # the stall notes land durably (black-box on this tier)
+            # and the timeline renders them as event marks
+            members, failed = await cli.collect_timeline(addrs)
+            assert not failed
+            timeline = assemble_timeline(members, failed_members=failed,
+                                         last_s=60)
+            stalls = [e for e in timeline["events"]
+                      if e["kind"] == "loop_stall"]
+            assert stalls
+            assert any("_nemesis_synchronous_hold" in e["detail"]
+                       for e in stalls)
+        finally:
+            for ln in listeners:
+                await ln.close()
+            await cluster.close()
+
+    arun(run(), timeout=180)

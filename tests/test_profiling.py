@@ -73,3 +73,39 @@ def test_summarize_trace_without_xprof_is_actionable(tmp_path, monkeypatch):
     (d / "host.xplane.pb").write_bytes(b"x")
     with pytest.raises(RuntimeError, match="xprof"):
         summarize_trace(str(tmp_path))
+
+def test_cli_profile_device_routes_through_summarize_trace(
+        tmp_path, monkeypatch, capsys):
+    """``copycat-tpu profile --device <dir>`` is the device-side door:
+    it routes through summarize_trace (monkeypatched here — no xprof
+    needed), renders the op table, and keeps the actionable error
+    when the trace dir is empty."""
+    import json
+
+    from copycat_tpu import cli
+
+    def _ns(**kw):
+        return type("A", (), kw)()
+
+    calls = []
+
+    def fake_summarize(trace_dir, top=15):
+        calls.append((trace_dir, top))
+        return [("fusion.42", 4.0, 2), ("copy.7", 0.5, 1)]
+
+    # _profile_device imports lazily -> patch the source module
+    monkeypatch.setattr("copycat_tpu.utils.profiling.summarize_trace",
+                        fake_summarize)
+    ns = _ns(addresses=[], last=None, top=5, json=True, diff=None,
+             device=str(tmp_path))
+    assert cli._profile(ns) == 0
+    assert calls == [(str(tmp_path), 5)]
+    rows = json.loads(capsys.readouterr().out)
+    assert rows == [{"op": "fusion.42", "total_ms": 4.0, "count": 2},
+                    {"op": "copy.7", "total_ms": 0.5, "count": 1}]
+    # the real thing against an empty dir: one-line error, exit 1
+    monkeypatch.undo()
+    ns = _ns(addresses=[], last=None, top=5, json=False, diff=None,
+             device=str(tmp_path))
+    assert cli._profile(ns) == 1
+    assert "xplane.pb" in capsys.readouterr().err

@@ -330,6 +330,44 @@ def test_cli_top_once(capsys):
     assert rc == 1
 
 
+def test_cli_top_json_one_shot(capsys):
+    """``top --json`` is the machine-readable one-shot the CI smoke
+    reads: one frame as JSON, ``commit_rate`` honestly ``null`` (a
+    single poll has no delta), unreachable members as rows."""
+    async def run():
+        cluster = await create_cluster(1)
+        try:
+            client = await cluster.client()
+            await client.submit(Put(key="k", value=1))
+            listener = await StatsListener(cluster.servers[0],
+                                           port=0).open()
+            try:
+                addr = f"127.0.0.1:{listener.port}"
+                rc = await asyncio.to_thread(
+                    cli._top, _ns(addresses=[addr, "127.0.0.1:1"],
+                                  watch=0.1, once=False, json=True))
+                assert rc == 0
+                frame = json.loads(capsys.readouterr().out)
+                assert frame["failed"] == ["127.0.0.1:1"]
+                member = str(cluster.servers[0].address)
+                row = frame["members"][member]
+                assert row["role"] in ("leader", "follower", "candidate")
+                assert row["commit_rate"] is None  # one poll, no delta
+                assert frame["worst_health"] in ("ok", "warn",
+                                                 "critical",
+                                                 "unreachable")
+            finally:
+                await listener.close()
+        finally:
+            await cluster.close()
+
+    arun(run(), timeout=120)
+    # every member down: --json exits 1 like --once
+    rc = cli._top(_ns(addresses=["127.0.0.1:1"], watch=0.1, once=False,
+                      json=True))
+    assert rc == 1
+
+
 def test_cli_parser_registers_new_verbs_and_doctor_last(capsys):
     import pytest as _pytest
 
